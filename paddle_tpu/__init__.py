@@ -1,0 +1,51 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid 1.5 (reference at /root/reference, surveyed in SURVEY.md).
+
+Fluid's contract — declarative Program IR built from Python layers, autodiff
+and distribution as program transformations, Executor.run(feed, fetch) — with
+a new execution model: whole-block lowering to XLA via JAX, SPMD parallelism
+over jax.sharding meshes, and Pallas kernels for hot ops.
+"""
+from . import core  # noqa: F401
+from . import ops  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import parallel  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .framework import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+)
+from .param_attr import ParamAttr  # noqa: F401
+
+# Place objects: thin tags for API parity (reference platform/place.h:79).
+# Device selection is JAX's job; these only pick cpu vs tpu backends.
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# CUDAPlace intentionally absent: zero CUDA in this build (BASELINE.json).
+
+__version__ = "0.1.0"

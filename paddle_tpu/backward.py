@@ -1,0 +1,159 @@
+"""Autodiff by program transformation: `append_backward`.
+
+TPU-native re-design of /root/reference/python/paddle/fluid/backward.py
+(append_backward:558, _addup_repetitive_outputs_:135, _find_op_path_:780).
+The contract is identical — walk the forward op list in reverse, emit one grad
+op per forward op (via each op's grad maker), sum repeated gradients, and
+return (param, grad_var) pairs for the optimizer — but grad *kernels* are
+derived from the forward JAX computes via vjp (see ops/registry.py), so this
+file only orchestrates naming and topology, never math.
+"""
+from __future__ import annotations
+
+from .framework import Program, Variable, grad_var_name
+from .ops.registry import default_grad_maker, get_op_def
+
+__all__ = ["append_backward", "gradients"]
+
+
+def _find_op_path(block, loss_name: str) -> list[int]:
+    """Indices of ops that (transitively) produce `loss_name` from data/params.
+
+    Mirrors the reference's _find_op_path_ (backward.py:780): a backward sweep
+    collecting ops whose outputs are needed.
+    """
+    needed = {loss_name}
+    path = []
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if any(n in needed for n in op.output_names):
+            path.append(i)
+            needed.update(n for n in op.input_names if n)
+    path.reverse()
+    return path
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: list[str] | None = None,
+    no_grad_set: set[str] | None = None,
+    callbacks=None,
+):
+    """Append grad ops for `loss` to its program; return [(param, grad)] pairs.
+
+    Reference: backward.py:558. Only single-block programs are differentiated
+    in-line; control-flow sub-blocks differentiate through their op's vjp
+    (the while/cond op kernels are themselves JAX-traceable).
+    """
+    program: Program = loss.block.program
+    block = program.global_block
+    no_grad = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient and not v.persistable:
+            no_grad.add(v.name)
+
+    op_path = _find_op_path(block, loss.name)
+
+    # 1. seed: d loss / d loss = 1
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype)
+    block.append_op(
+        "fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss.shape), "value": 1.0, "dtype": loss.dtype.value},
+    )
+
+    # 2. reverse sweep, with repeated-grad accumulation
+    available_grads = {loss_grad}
+    pending_sum: dict[str, list[str]] = {}  # fwd var -> partial grad var names
+
+    ops_snapshot = [block.ops[i] for i in op_path]
+    for op in reversed(ops_snapshot):
+        opdef = get_op_def(op.type) if _has(op.type) else None
+        if opdef is None or opdef.no_grad:
+            continue
+        if not any(grad_var_name(n) in available_grads or n == loss.name for n in op.output_names):
+            # no grad flows into this op's outputs
+            continue
+        maker = opdef.grad_maker or default_grad_maker
+        specs = maker(op, block, frozenset(no_grad))
+        for spec in specs:
+            # rename repeated-grad outputs: if a grad var was already produced
+            # by another consumer — or appears twice within THIS spec (e.g.
+            # elementwise_mul(x, x) emits X@GRAD and Y@GRAD for the same var) —
+            # emit into a temp and sum (reference _addup_repetitive_outputs_
+            # backward.py:135)
+            outputs = {}
+            renames = []
+            local_seen: set[str] = set()
+            for slot, names in spec["outputs"].items():
+                new_names = []
+                for n in names:
+                    if n and (n in available_grads or n in local_seen):
+                        tmp = n + "@RENAME@" + str(len(pending_sum.get(n, [])))
+                        pending_sum.setdefault(n, [n]).append(tmp)
+                        renames.append((n, tmp))
+                        new_names.append(tmp)
+                    else:
+                        if n:
+                            local_seen.add(n)
+                        new_names.append(n)
+                outputs[slot] = new_names
+            block.append_op(spec["type"], spec["inputs"], outputs, spec.get("attrs", {}))
+            for slot, names in outputs.items():
+                for n in names:
+                    if n:
+                        available_grads.add(n)
+            # fold pending sums immediately when a rename happened
+            for orig, tmp in renames:
+                parts = pending_sum[orig]
+                if len(parts) >= 2:
+                    block.append_op(
+                        "sum",
+                        inputs={"X": list(parts)},
+                        outputs={"Out": [orig]},
+                    )
+                    pending_sum[orig] = [orig]
+        # make this op's input-grads visible
+    # 3. collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if getattr(p, "trainable", True)]
+    result = []
+    for p in params:
+        g = grad_var_name(p.name)
+        if g in available_grads:
+            result.append((p, block.var(g)))
+    return result
+
+
+def _has(t):
+    try:
+        get_op_def(t)
+        return True
+    except KeyError:
+        return False
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Compute grads of targets w.r.t. inputs (reference backward.py:938)."""
+    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "gradients(target_gradients=...) is not supported yet; seed "
+            "cotangents by scaling the target before calling gradients()."
+        )
+    if len(tgts) > 1:
+        raise NotImplementedError(
+            "gradients() over multiple targets is not supported yet; sum the "
+            "targets into one scalar first."
+        )
+    append_backward(tgts[0], parameter_list=None, no_grad_set=no_grad_set)
+    block = tgts[0].block.program.global_block
+    out = []
+    for v in ins:
+        g = grad_var_name(v.name)
+        out.append(block.var(g) if block.has_var(g) else None)
+    return out

@@ -1,0 +1,114 @@
+"""Gradient clipping (reference /root/reference/python/paddle/fluid/clip.py:
+GradientClipByValue:132, GradientClipByNorm:196, GradientClipByGlobalNorm:261,
+set_gradient_clip:332, append_gradient_clip_ops:367).
+
+The global-norm clip builds the reduction as ops in the program, so under
+XLA+GSPMD the norm is computed once per step, fused, and — in data-parallel
+runs — on already-allreduced grads.
+"""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "set_gradient_clip",
+    "append_gradient_clip_ops",
+    "error_clip_callback",
+]
+
+
+class BaseGradientClipAttr:
+    def _create_operators(self, param, grad, helper):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _create_operators(self, param, grad, helper):
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        helper.append_op(
+            "clip",
+            inputs={"X": [grad]},
+            outputs={"Out": [out]},
+            attrs={"min": self.min, "max": self.max},
+        )
+        return param, out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _create_operators(self, param, grad, helper):
+        out = helper.create_variable_for_type_inference(grad.dtype)
+        helper.append_op(
+            "clip_by_norm",
+            inputs={"X": [grad]},
+            outputs={"Out": [out]},
+            attrs={"max_norm": self.clip_norm},
+        )
+        return param, out
+
+
+class GradientClipByGlobalNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_all(self, params_grads, helper):
+        from .layers import nn as L
+        from .layers import tensor as T
+
+        sq_norms = []
+        for _, g in params_grads:
+            if g is None:
+                continue
+            sq = helper.create_variable_for_type_inference(g.dtype)
+            helper.append_op("squared_l2_norm", inputs={"X": [g]}, outputs={"Out": [sq]})
+            sq_norms.append(sq)
+        global_sq = helper.create_variable_for_type_inference(sq_norms[0].dtype)
+        helper.append_op("sum", inputs={"X": sq_norms}, outputs={"Out": [global_sq]})
+        global_norm = L.sqrt(global_sq)
+        clip_var = T.fill_constant([1], "float32", self.clip_norm)
+        # scale = clip / max(clip, global_norm)
+        denom = L.elementwise_max(global_norm, clip_var)
+        factor = L.elementwise_div(clip_var, denom)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            ng = L.elementwise_mul(g, factor)
+            out.append((p, ng))
+        return out
+
+
+_global_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_clip
+    _global_clip = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    helper = LayerHelper("gradient_clip")
+    if isinstance(_global_clip, GradientClipByGlobalNorm):
+        return _global_clip._clip_all(params_grads, helper)
+    out = []
+    for p, g in params_grads:
+        clip_attr = getattr(p, "gradient_clip_attr", None) or _global_clip
+        if g is None or clip_attr is None:
+            out.append((p, g))
+            continue
+        out.append(clip_attr._create_operators(p, g, helper))
+    return out
+
+
+def error_clip_callback(block, context):
+    pass

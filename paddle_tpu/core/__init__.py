@@ -1,0 +1,1 @@
+from .types import DType, VarKind, is_floating, np_dtype  # noqa: F401

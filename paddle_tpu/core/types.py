@@ -1,0 +1,100 @@
+"""Core type system: dtypes and variable types.
+
+TPU-native equivalent of the reference's VarType proto
+(/root/reference/paddle/fluid/framework/framework.proto:105) — we keep the
+same *contract* (named dtypes, tensor/reader/step-scope var kinds) but store
+them as plain Python enums serializable to JSON instead of protobuf, and map
+dtypes directly onto JAX/numpy dtypes (bfloat16 is first-class for TPU).
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+try:  # jax provides a real bfloat16 numpy scalar type
+    import jax.numpy as jnp
+
+    _bfloat16 = jnp.bfloat16
+except Exception:  # pragma: no cover
+    _bfloat16 = np.float32
+
+
+class VarKind(enum.Enum):
+    """What a Variable holds (reference: framework.proto VarType.Type)."""
+
+    DENSE_TENSOR = "dense_tensor"  # reference LOD_TENSOR — TPU build uses padded dense
+    SELECTED_ROWS = "selected_rows"  # sparse row-set (embedding grads)
+    READER = "reader"
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+class DType(enum.Enum):
+    """Named dtypes; values are the canonical string spelling."""
+
+    FP64 = "float64"
+    FP32 = "float32"
+    FP16 = "float16"
+    BF16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT16 = "int16"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+    @property
+    def np(self):
+        return _NP_MAP[self]
+
+    @staticmethod
+    def parse(x) -> "DType":
+        if isinstance(x, DType):
+            return x
+        if isinstance(x, str):
+            return DType(_STR_ALIASES.get(x, x))
+        # numpy dtype / type object
+        name = np.dtype(x).name if x is not _bfloat16 else "bfloat16"
+        try:
+            name = np.dtype(x).name
+        except TypeError:
+            name = str(x)
+        if "bfloat16" in name:
+            return DType.BF16
+        return DType(name)
+
+
+_NP_MAP = {
+    DType.FP64: np.float64,
+    DType.FP32: np.float32,
+    DType.FP16: np.float16,
+    DType.BF16: _bfloat16,
+    DType.INT64: np.int64,
+    DType.INT32: np.int32,
+    DType.INT16: np.int16,
+    DType.INT8: np.int8,
+    DType.UINT8: np.uint8,
+    DType.BOOL: np.bool_,
+}
+
+_STR_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+
+def np_dtype(dtype) -> np.dtype:
+    """Resolve any dtype spelling to a numpy dtype (bfloat16 aware)."""
+    d = DType.parse(dtype)
+    return np.dtype(d.np)
+
+
+def is_floating(dtype) -> bool:
+    return DType.parse(dtype) in (DType.FP64, DType.FP32, DType.FP16, DType.BF16)

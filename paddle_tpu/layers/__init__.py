@@ -1,0 +1,4 @@
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from . import nn  # noqa: F401
+from . import tensor  # noqa: F401

@@ -1,0 +1,1004 @@
+"""Layers DSL — each function appends ops to the default main program.
+
+TPU-native re-design of /root/reference/python/paddle/fluid/layers/nn.py
+(fc:228, embedding, conv2d, pool2d, batch_norm, layer_norm, dropout, softmax,
+cross_entropy, softmax_with_cross_entropy, reduce_*, elementwise_*, matmul,
+topk, accuracy) — same public signatures, new lowering (each op is a JAX
+compute traced into one XLA block; see ops/).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.types import DType
+from ..framework import Variable
+from ..initializer import Constant, Normal, Xavier
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc",
+    "embedding",
+    "conv2d",
+    "conv2d_transpose",
+    "pool2d",
+    "batch_norm",
+    "layer_norm",
+    "group_norm",
+    "dropout",
+    "softmax",
+    "log_softmax",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "square_error_cost",
+    "smooth_l1",
+    "mean",
+    "mul",
+    "matmul",
+    "relu",
+    "sigmoid",
+    "tanh",
+    "gelu",
+    "leaky_relu",
+    "exp",
+    "log",
+    "sqrt",
+    "square",
+    "abs",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "scale",
+    "sums",
+    "cast",
+    "reshape",
+    "flatten",
+    "transpose",
+    "concat",
+    "split",
+    "slice",
+    "squeeze",
+    "unsqueeze",
+    "stack",
+    "unstack",
+    "expand",
+    "gather",
+    "scatter",
+    "one_hot",
+    "topk",
+    "argmax",
+    "argmin",
+    "argsort",
+    "accuracy",
+    "label_smooth",
+    "clip",
+    "clip_by_norm",
+    "pad",
+    "pad2d",
+    "prelu",
+    "l2_normalize",
+    "dot",
+    "cos_sim",
+    "pow",
+    "where",
+    "shape",
+    "increment",
+    "cumsum",
+    "lod_reset",
+]
+
+
+def _elementwise_binary(op_type: str, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    if not isinstance(y, Variable):
+        # scalar operand — lower to `scale` (fused by XLA anyway)
+        if op_type == "elementwise_add":
+            return scale(x, scale=1.0, bias=float(y))
+        if op_type == "elementwise_sub":
+            return scale(x, scale=1.0, bias=-float(y))
+        if op_type == "elementwise_mul":
+            return scale(x, scale=float(y))
+        if op_type == "elementwise_div":
+            return scale(x, scale=1.0 / float(y))
+        from .tensor import fill_constant
+
+        y = fill_constant(shape=[1], dtype=x.dtype.value, value=float(y))
+    if not isinstance(x, Variable):
+        # scalar on the left: lower to scale/reciprocal forms (elementwise
+        # broadcast aligns Y to X, so a [1]-shaped X would mis-broadcast)
+        if op_type == "elementwise_add":
+            return scale(y, scale=1.0, bias=float(x))
+        if op_type == "elementwise_mul":
+            return scale(y, scale=float(x))
+        if op_type == "elementwise_sub":
+            return scale(y, scale=-1.0, bias=float(x))
+        if op_type == "elementwise_div":
+            return scale(_unary("reciprocal", y), scale=float(x))
+        from .tensor import fill_constant
+
+        x = fill_constant(shape=[1], dtype=y.dtype.value, value=float(x))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        op_type,
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return helper.append_activation(out, act)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise_binary("elementwise_pow", x, y, axis, act, name)
+
+
+def fc(
+    input,
+    size,
+    num_flatten_dims=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    """Fully-connected layer (reference nn.py:228)."""
+    helper = LayerHelper("fc", name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for inp in inputs:
+        in_dim = int(np.prod(inp.shape[num_flatten_dims:]))
+        w = helper.create_parameter(param_attr, [in_dim, size], inp.dtype)
+        tmp = helper.create_variable_for_type_inference(inp.dtype)
+        helper.append_op(
+            "mul",
+            inputs={"X": [inp], "Y": [w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(inputs[0].dtype)
+        helper.append_op("sum", inputs={"X": mul_results}, outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, bias_attr) if bias_attr is not False else pre_bias
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(
+    input,
+    size,
+    is_sparse=False,
+    is_distributed=False,
+    padding_idx=None,
+    param_attr=None,
+    dtype="float32",
+    name=None,
+):
+    """Embedding lookup (reference nn.py lookup_table). `is_sparse` keeps the
+    API; on TPU the grad is a dense scatter-add fused by XLA."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(param_attr, list(size), dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "is_sparse": is_sparse,
+            "is_distributed": is_distributed,
+            "padding_idx": -1 if padding_idx is None else padding_idx,
+        },
+    )
+    return out
+
+
+def conv2d(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+    use_cudnn=True,  # accepted for API parity; XLA owns the implementation
+):
+    helper = LayerHelper("conv2d", name=name)
+    num_channels = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    w_shape = [num_filters, num_channels // groups, fs[0], fs[1]]
+    fan_in = (num_channels // groups) * fs[0] * fs[1]
+    w = helper.create_parameter(
+        param_attr, w_shape, input.dtype,
+        default_initializer=Normal(0.0, (2.0 / fan_in) ** 0.5),
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "depthwise_conv2d" if groups == num_channels and num_filters == num_channels else "conv2d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={
+            "strides": list(stride if isinstance(stride, (list, tuple)) else (stride, stride)),
+            "paddings": list(padding if isinstance(padding, (list, tuple)) else (padding, padding)),
+            "dilations": list(dilation if isinstance(dilation, (list, tuple)) else (dilation, dilation)),
+            "groups": groups,
+        },
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype, is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [out], "Y": [b]},
+            outputs={"Out": [tmp]},
+            attrs={"axis": 1},
+        )
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(
+    input,
+    num_filters,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("conv2d_transpose", name=name)
+    num_channels = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    w = helper.create_parameter(
+        param_attr, [num_channels, num_filters, fs[0], fs[1]], input.dtype
+    )
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={
+            "strides": list(stride if isinstance(stride, (list, tuple)) else (stride, stride)),
+            "paddings": list(padding if isinstance(padding, (list, tuple)) else (padding, padding)),
+            "dilations": list(dilation if isinstance(dilation, (list, tuple)) else (dilation, dilation)),
+        },
+    )
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype, is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": [out], "Y": [b]},
+            outputs={"Out": [tmp]},
+            attrs={"axis": 1},
+        )
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def pool2d(
+    input,
+    pool_size=2,
+    pool_type="max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling=False,
+    exclusive=True,
+    name=None,
+    use_cudnn=True,
+):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": list(pool_size if isinstance(pool_size, (list, tuple)) else (pool_size, pool_size)),
+            "strides": list(
+                pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride, pool_stride)
+            ),
+            "paddings": list(
+                pool_padding if isinstance(pool_padding, (list, tuple)) else (pool_padding, pool_padding)
+            ),
+            "global_pooling": global_pooling,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout="NCHW",
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    use_global_stats=False,
+):
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(
+        param_attr, [c], "float32", default_initializer=Constant(1.0)
+    )
+    bias = helper.create_parameter(bias_attr, [c], "float32", is_bias=True)
+    mean = helper.create_or_get_global_variable(
+        moving_mean_name or helper.name + ".mean", [c], "float32", initializer=Constant(0.0)
+    )
+    var = helper.create_or_get_global_variable(
+        moving_variance_name or helper.name + ".var", [c], "float32", initializer=Constant(1.0)
+    )
+    y = helper.create_variable_for_type_inference(input.dtype)
+    saved_mean = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias], "Mean": [mean], "Variance": [var]},
+        outputs={
+            "Y": [y],
+            "MeanOut": [mean],
+            "VarianceOut": [var],
+            "SavedMean": [saved_mean],
+            "SavedVariance": [saved_var],
+        },
+        attrs={
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test or use_global_stats,
+            "data_layout": data_layout,
+        },
+    )
+    return helper.append_activation(y, act)
+
+
+def layer_norm(
+    input,
+    scale=True,
+    shift=True,
+    begin_norm_axis=1,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(
+            param_attr, norm_shape, "float32", default_initializer=Constant(1.0)
+        )
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, "float32", is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    var = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    helper.append_op(
+        "layer_norm",
+        inputs=inputs,
+        outputs={"Y": [y], "Mean": [mean], "Variance": [var]},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    return helper.append_activation(y, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("group_norm", name=name)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        inputs["Scale"] = [
+            helper.create_parameter(param_attr, [c], "float32", default_initializer=Constant(1.0))
+        ]
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, [c], "float32", is_bias=True)]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    var = helper.create_variable_for_type_inference("float32", stop_gradient=True)
+    helper.append_op(
+        "group_norm",
+        inputs=inputs,
+        outputs={"Y": [y], "Mean": [mean], "Variance": [var]},
+        attrs={"groups": groups, "epsilon": epsilon},
+    )
+    return helper.append_activation(y, act)
+
+
+def dropout(
+    x,
+    dropout_prob,
+    is_test=False,
+    seed=None,
+    name=None,
+    dropout_implementation="downgrade_in_infer",
+):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "dropout",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "seed": seed or 0,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+def _unary(op_type, x, name=None, **attrs):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def relu(x, name=None):
+    return _unary("relu", x, name)
+
+
+def sigmoid(x, name=None):
+    return _unary("sigmoid", x, name)
+
+
+def tanh(x, name=None):
+    return _unary("tanh", x, name)
+
+
+def gelu(x, name=None):
+    return _unary("gelu", x, name)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _unary("leaky_relu", x, name, alpha=alpha)
+
+
+def exp(x, name=None):
+    return _unary("exp", x, name)
+
+
+def log(x, name=None):
+    return _unary("log", x, name)
+
+
+def sqrt(x, name=None):
+    return _unary("sqrt", x, name)
+
+
+def square(x, name=None):
+    return _unary("square", x, name)
+
+
+def abs(x, name=None):
+    return _unary("abs", x, name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _unary("pow", x, name, factor=factor)
+
+
+def softmax(input, axis=-1, name=None, use_cudnn=False):
+    return _unary("softmax", input, name, axis=axis)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _unary("log_softmax", input, name, axis=axis)
+
+
+def clip(x, min, max, name=None):
+    return _unary("clip", x, name, min=min, max=max)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _unary("clip_by_norm", x, name, max_norm=max_norm)
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "mul",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "matmul",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": alpha},
+    )
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100, name=None):
+    helper = LayerHelper("cross_entropy", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "cross_entropy",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(
+    logits,
+    label,
+    soft_label=False,
+    ignore_index=-100,
+    numeric_stable_mode=True,
+    return_softmax=False,
+    name=None,
+):
+    helper = LayerHelper("softmax_with_cross_entropy", name=name)
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Softmax": [softmax_out], "Loss": [loss]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, normalize=False, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x], "Label": [label]},
+        outputs={"Out": [out]},
+        attrs={"ignore_index": ignore_index, "normalize": normalize},
+    )
+    return out
+
+
+def square_error_cost(input, label, name=None):
+    helper = LayerHelper("square_error_cost", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "square_error_cost",
+        inputs={"X": [input], "Y": [label]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0, name=None):
+    helper = LayerHelper("smooth_l1_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op(
+        "smooth_l1_loss",
+        inputs=inputs,
+        outputs={"Out": [loss], "Diff": [diff]},
+        attrs={"sigma": sigma},
+    )
+    return loss
+
+
+def _reduce(op_type, input, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if dim is None:
+        attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        attrs = {
+            "dim": dim if isinstance(dim, (list, tuple)) else [dim],
+            "keep_dim": keep_dim,
+            "reduce_all": False,
+        }
+    helper.append_op(op_type, inputs={"X": [input]}, outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "scale",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "bias": float(bias), "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out, act)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", inputs={"X": input}, outputs={"Out": [out]})
+    return out
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    dtype = DType.parse(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "cast",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"out_dtype": dtype.value, "in_dtype": x.dtype.value},
+    )
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "reshape2",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape)},
+    )
+    return helper.append_activation(out, act)
+
+
+def flatten(x, axis=1, name=None):
+    return _unary("flatten2", x, name, axis=axis)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "transpose2", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"axis": list(perm)}
+    )
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", inputs={"X": input}, outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim % len(input.shape)
+    if isinstance(num_or_sections, int):
+        num, sections = num_or_sections, []
+        n_out = num_or_sections
+    else:
+        num, sections = 0, list(num_or_sections)
+        n_out = len(sections)
+    outs = [helper.create_variable_for_type_inference(input.dtype) for _ in range(n_out)]
+    helper.append_op(
+        "split",
+        inputs={"X": [input]},
+        outputs={"Out": outs},
+        attrs={"axis": dim, "num": num, "sections": sections},
+    )
+    return outs
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "slice",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+    return out
+
+
+def squeeze(input, axes, name=None):
+    return _unary("squeeze2", input, name, axes=list(axes))
+
+
+def unsqueeze(input, axes, name=None):
+    return _unary("unsqueeze2", input, name, axes=list(axes))
+
+
+def stack(x, axis=0, name=None):
+    helper = LayerHelper("stack", name=name)
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", inputs={"X": x}, outputs={"Y": [out]}, attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None, name=None):
+    helper = LayerHelper("unstack", name=name)
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(n)]
+    helper.append_op("unstack", inputs={"X": [x]}, outputs={"Y": outs}, attrs={"axis": axis})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    return _unary("expand", x, name, expand_times=list(expand_times))
+
+
+def gather(input, index, name=None):
+    helper = LayerHelper("gather", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", inputs={"X": [input], "Index": [index]}, outputs={"Out": [out]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "scatter",
+        inputs={"X": [input], "Ids": [index], "Updates": [updates]},
+        outputs={"Out": [out]},
+        attrs={"overwrite": overwrite},
+    )
+    return out
+
+
+def one_hot(input, depth, name=None):
+    helper = LayerHelper("one_hot", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "one_hot", inputs={"X": [input]}, outputs={"Out": [out]}, attrs={"depth": depth}
+    )
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "top_k",
+        inputs={"X": [input]},
+        outputs={"Out": [values], "Indices": [indices]},
+        attrs={"k": k},
+    )
+    return values, indices
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_max", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_min", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "argsort",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "Indices": [idx]},
+        attrs={"axis": axis},
+    )
+    return out, idx
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Classification accuracy (reference layers/metric_op.py:32)."""
+    helper = LayerHelper("accuracy")
+    _, indices = topk(input, k)
+    acc = helper.create_variable_for_type_inference("float32")
+    correct = correct or helper.create_variable_for_type_inference("int32")
+    total = total or helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "accuracy",
+        inputs={"Out": [input], "Indices": [indices], "Label": [label]},
+        outputs={"Accuracy": [acc], "Correct": [correct], "Total": [total]},
+    )
+    return acc
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(
+        "label_smooth", inputs=inputs, outputs={"Out": [out]}, attrs={"epsilon": float(epsilon)}
+    )
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _unary("pad", x, name, paddings=list(paddings), pad_value=float(pad_value))
+
+
+def pad2d(input, paddings, mode="constant", pad_value=0.0, name=None):
+    return _unary("pad2d", input, name, paddings=list(paddings), mode=mode, pad_value=float(pad_value))
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        param_attr, alpha_shape, x.dtype, default_initializer=Constant(0.25)
+    )
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "prelu",
+        inputs={"X": [x], "Alpha": [alpha]},
+        outputs={"Out": [out]},
+        attrs={"mode": mode},
+    )
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "norm",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Norm": [norm]},
+        attrs={"axis": axis, "epsilon": epsilon},
+    )
+    return out
+
+
+def dot(x, y, name=None):
+    helper = LayerHelper("dot", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("dot", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype, stop_gradient=True)
+    yn = helper.create_variable_for_type_inference(X.dtype, stop_gradient=True)
+    helper.append_op(
+        "cos_sim",
+        inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]},
+    )
+    return out
+
+
+def where(condition, x, y, name=None):
+    helper = LayerHelper("where", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "where",
+        inputs={"Condition": [condition], "X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def shape(input, name=None):
+    helper = LayerHelper("shape", name=name)
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op("shape", inputs={"X": [input]}, outputs={"Out": [out]})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "increment", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"step": float(value)}
+    )
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    return _unary("cum", x, name, axis=axis, exclusive=exclusive, reverse=reverse)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """LoD is replaced by padding + segment ids on TPU (SURVEY.md §5); this is
+    an identity kept for API compatibility."""
+    return x

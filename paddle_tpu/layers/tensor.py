@@ -1,0 +1,174 @@
+"""Tensor-creation layers + the `data` input declaration.
+
+Reference: /root/reference/python/paddle/fluid/layers/tensor.py and
+layers/io.py (`data`:45).
+"""
+from __future__ import annotations
+
+from ..core.types import DType
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "data",
+    "fill_constant",
+    "zeros",
+    "ones",
+    "assign",
+    "create_tensor",
+    "create_global_var",
+    "fill_constant_batch_size_like",
+    "zeros_like",
+    "ones_like",
+    "linspace",
+    "range",
+]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop_gradient=True):
+    """Declare a feed input (reference layers/io.py:45). With
+    append_batch_size=True a leading -1 batch dim is added; each concrete batch
+    size becomes one XLA compile-cache entry."""
+    if append_batch_size:
+        shape = [-1] + list(shape)
+    block = default_main_program().current_block()
+    return block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        is_data=True,
+        stop_gradient=stop_gradient,
+    )
+
+
+def fill_constant(shape, dtype, value, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(DType.parse(dtype))
+    helper.append_op(
+        "fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": DType.parse(dtype).value, "value": float(value)},
+    )
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(DType.parse(dtype))
+    helper.append_op(
+        "fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": list(shape),
+            "dtype": DType.parse(dtype).value,
+            "value": float(value),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+        },
+    )
+    return out
+
+
+def zeros(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 0.0, name=name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return fill_constant(shape, dtype, 1.0, name=name)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("fill_any_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"value": 1.0}
+    )
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    from ..framework import Variable
+    import numpy as np
+
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("assign", inputs={"X": [input]}, outputs={"Out": [output]})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(DType.parse(arr.dtype))
+        helper.append_op(
+            "assign_value",
+            outputs={"Out": [output]},
+            attrs={
+                "shape": list(arr.shape),
+                "dtype": DType.parse(arr.dtype).value,
+                "values": arr.reshape(-1).tolist(),
+            },
+        )
+    return output
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_global_variable(
+        shape=[1], dtype=dtype, persistable=persistable, name=name
+    )
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    from ..initializer import Constant
+
+    helper = LayerHelper("global_var", name=name)
+    return helper.create_or_get_global_variable(
+        name or helper.name,
+        shape,
+        dtype,
+        persistable=persistable,
+        initializer=Constant(value),
+    )
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(DType.parse(dtype))
+    helper.append_op(
+        "linspace",
+        outputs={"Out": [out]},
+        attrs={
+            "start": float(start),
+            "stop": float(stop),
+            "num": int(num),
+            "dtype": DType.parse(dtype).value,
+        },
+    )
+    return out
+
+
+def range(start, end, step, dtype="int64"):
+    helper = LayerHelper("range")
+    out = helper.create_variable_for_type_inference(DType.parse(dtype))
+    helper.append_op(
+        "range",
+        outputs={"Out": [out]},
+        attrs={
+            "start": float(start),
+            "end": float(end),
+            "step": float(step),
+            "dtype": DType.parse(dtype).value,
+        },
+    )
+    return out

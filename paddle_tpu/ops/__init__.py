@@ -1,0 +1,18 @@
+"""Op registry + kernel modules. Importing this package registers all ops."""
+from .registry import (
+    ExecContext,
+    OpDef,
+    all_op_types,
+    default_grad_maker,
+    get_op_def,
+    has_op,
+    infer_op,
+    register_grad_compute,
+    register_op,
+)
+
+from . import math_ops  # noqa: F401
+from . import activation_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

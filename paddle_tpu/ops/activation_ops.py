@@ -1,0 +1,107 @@
+"""Activation ops (reference: /root/reference/paddle/fluid/operators/activation_op.cc).
+
+Every activation is a one-liner over jnp/jax.nn; gradients derive via vjp and
+XLA fuses them into neighbouring matmuls — the reference's hand-fused
+fuse_relu_depthwise_conv / fused_elemwise_activation passes are unnecessary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ExecContext, register_op
+
+
+def _act(fn):
+    def compute(ctx: ExecContext):
+        return {"Out": fn(ctx.input("X"))}
+
+    return compute
+
+
+register_op("relu")(_act(jax.nn.relu))
+register_op("sigmoid")(_act(jax.nn.sigmoid))
+register_op("tanh")(_act(jnp.tanh))
+register_op("exp")(_act(jnp.exp))
+register_op("log")(_act(jnp.log))
+register_op("sqrt")(_act(jnp.sqrt))
+register_op("rsqrt")(_act(lambda x: 1.0 / jnp.sqrt(x)))
+register_op("square")(_act(jnp.square))
+register_op("abs")(_act(jnp.abs))
+register_op("reciprocal")(_act(lambda x: 1.0 / x))
+register_op("softplus")(_act(jax.nn.softplus))
+register_op("softsign")(_act(lambda x: x / (1.0 + jnp.abs(x))))
+register_op("gelu")(_act(lambda x: jax.nn.gelu(x, approximate=False)))
+register_op("relu6")(_act(lambda x: jnp.clip(x, 0.0, 6.0)))
+register_op("ceil", no_grad=True)(_act(jnp.ceil))
+register_op("floor", no_grad=True)(_act(jnp.floor))
+register_op("round", no_grad=True)(_act(jnp.round))
+register_op("sin")(_act(jnp.sin))
+register_op("cos")(_act(jnp.cos))
+register_op("sign", no_grad=True)(_act(jnp.sign))
+register_op("logsigmoid")(_act(jax.nn.log_sigmoid))
+
+
+@register_op("leaky_relu")
+def leaky_relu(ctx: ExecContext):
+    x = ctx.input("X")
+    alpha = ctx.attr("alpha", 0.02)
+    return {"Out": jnp.where(x >= 0, x, x * jnp.asarray(alpha, x.dtype))}
+
+
+@register_op("elu")
+def elu(ctx: ExecContext):
+    x = ctx.input("X")
+    alpha = jnp.asarray(ctx.attr("alpha", 1.0), x.dtype)
+    return {"Out": jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ctx: ExecContext):
+    x = ctx.input("X")
+    slope = jnp.asarray(ctx.attr("slope", 0.2), x.dtype)
+    offset = jnp.asarray(ctx.attr("offset", 0.5), x.dtype)
+    return {"Out": jnp.clip(x * slope + offset, 0.0, 1.0)}
+
+
+@register_op("swish")
+def swish(ctx: ExecContext):
+    x = ctx.input("X")
+    beta = jnp.asarray(ctx.attr("beta", 1.0), x.dtype)
+    return {"Out": x * jax.nn.sigmoid(beta * x)}
+
+
+@register_op("brelu")
+def brelu(ctx: ExecContext):
+    x = ctx.input("X")
+    return {"Out": jnp.clip(x, ctx.attr("t_min", 0.0), ctx.attr("t_max", 24.0))}
+
+
+@register_op("soft_relu")
+def soft_relu(ctx: ExecContext):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 40.0)
+    return {"Out": jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ctx: ExecContext):
+    x = ctx.input("X")
+    return {"Out": jnp.where(x > ctx.attr("threshold", 1.0), x, jnp.zeros_like(x))}
+
+
+@register_op("hard_swish")
+def hard_swish(ctx: ExecContext):
+    x = ctx.input("X")
+    t = jnp.asarray(ctx.attr("threshold", 6.0), x.dtype)
+    s = jnp.asarray(ctx.attr("scale", 6.0), x.dtype)
+    o = jnp.asarray(ctx.attr("offset", 3.0), x.dtype)
+    return {"Out": x * jnp.clip(x + o, 0.0, t) / s}
+
+
+@register_op("stanh")
+def stanh(ctx: ExecContext):
+    x = ctx.input("X")
+    a = jnp.asarray(ctx.attr("scale_a", 2.0 / 3.0), x.dtype)
+    b = jnp.asarray(ctx.attr("scale_b", 1.7159), x.dtype)
+    return {"Out": b * jnp.tanh(a * x)}

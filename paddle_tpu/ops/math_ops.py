@@ -1,0 +1,220 @@
+"""Math ops: matmul/mul, elementwise family, reductions, scale/sum/mean.
+
+TPU-native equivalents of the reference kernels under
+/root/reference/paddle/fluid/operators/ (mul_op.cc, matmul_op.cc,
+elementwise/elementwise_*_op.*, reduce_ops/, scale_op.cc, sum_op.cc,
+mean_op.cc, clip_op.cc, cast_op.cc). Each op is one pure JAX function; XLA
+fuses elementwise chains into matmul epilogues on the MXU, so there is no
+hand-written fusion pass equivalent to fuse_elewise_add_act.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_op, register_grad_compute
+
+
+def _flatten_2d(x, num_col_dims: int):
+    """Flatten to 2D the way the reference mul_op does (mul_op.cc)."""
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    return x.reshape(lead, -1)
+
+
+@register_op("mul")
+def mul(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = ctx.attr("x_num_col_dims", 1)
+    yn = ctx.attr("y_num_col_dims", 1)
+    x2 = _flatten_2d(x, xn)
+    y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
+    out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("matmul")
+def matmul(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": out}
+
+
+# -- elementwise family with the reference's axis-broadcast rule -------------
+def _bcast_y(x, y, axis: int):
+    """Reference broadcast (elementwise_op_function.h): align y's dims to
+    x[axis : axis+y.ndim], padding trailing 1s."""
+    if x.shape == y.shape:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        new_shape[axis + i] = d
+    return y.reshape(new_shape)
+
+
+def _ew(fn):
+    def compute(ctx: ExecContext):
+        x, y = ctx.input("X"), ctx.input("Y")
+        y = _bcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return compute
+
+
+register_op("elementwise_add")(_ew(jnp.add))
+register_op("elementwise_sub")(_ew(jnp.subtract))
+register_op("elementwise_mul")(_ew(jnp.multiply))
+register_op("elementwise_div")(_ew(jnp.divide))
+register_op("elementwise_max")(_ew(jnp.maximum))
+register_op("elementwise_min")(_ew(jnp.minimum))
+register_op("elementwise_pow")(_ew(jnp.power))
+register_op("elementwise_mod", no_grad=True)(_ew(jnp.mod))
+register_op("elementwise_floordiv", no_grad=True)(_ew(jnp.floor_divide))
+
+
+@register_op("scale")
+def scale(ctx: ExecContext):
+    x = ctx.input("X")
+    s = jnp.asarray(ctx.attr("scale", 1.0), x.dtype)
+    b = jnp.asarray(ctx.attr("bias", 0.0), x.dtype)
+    if ctx.attr("bias_after_scale", True):
+        return {"Out": x * s + b}
+    return {"Out": (x + b) * s}
+
+
+@register_op("sum")
+def sum_op(ctx: ExecContext):
+    xs = [x for x in ctx.inputs("X") if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean")
+def mean(ctx: ExecContext):
+    return {"Out": jnp.mean(ctx.input("X"))}
+
+
+def _reduce(fn):
+    def compute(ctx: ExecContext):
+        x = ctx.input("X")
+        dims = ctx.attr("dim", [0])
+        keep = ctx.attr("keep_dim", False)
+        if ctx.attr("reduce_all", False):
+            axes = tuple(range(x.ndim))
+        else:
+            axes = tuple(d % x.ndim for d in (dims if isinstance(dims, (list, tuple)) else [dims]))
+        return {"Out": fn(x, axis=axes, keepdims=keep)}
+
+    return compute
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_all", no_grad=True)(_reduce(jnp.all))
+register_op("reduce_any", no_grad=True)(_reduce(jnp.any))
+
+
+@register_op("clip")
+def clip(ctx: ExecContext):
+    x = ctx.input("X")
+    return {"Out": jnp.clip(x, ctx.attr("min"), ctx.attr("max"))}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx: ExecContext):
+    x = ctx.input("X")
+    max_norm = jnp.asarray(ctx.attr("max_norm"), x.dtype)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": jnp.where(norm > max_norm, x * (max_norm / norm), x)}
+
+
+@register_op("cast")
+def cast(ctx: ExecContext):
+    from ..core.types import np_dtype
+
+    return {"Out": ctx.input("X").astype(np_dtype(ctx.attr("out_dtype")))}
+
+
+@register_op("dot")
+def dot(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx: ExecContext):
+    return {"Out": jnp.sum(jnp.square(ctx.input("X"))).reshape(1)}
+
+
+@register_op("norm")
+def norm(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / n, "Norm": n}
+
+
+@register_op("log_loss")
+def log_loss(ctx: ExecContext):
+    p = ctx.input("Predicted")
+    y = ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    return {"Loss": -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)}
+
+
+@register_op("huber_loss")
+def huber_loss(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    quad = 0.5 * r * r
+    lin = delta * (a - 0.5 * delta)
+    out = jnp.where(a <= delta, quad, lin)
+    return {"Out": out, "Residual": r}
+
+
+@register_op("square_error_cost")
+def square_error_cost(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("cos_sim")
+def cos_sim(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    return {
+        "Out": jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn),
+        "XNorm": xn,
+        "YNorm": yn,
+    }
+
+
+@register_op("pow")
+def pow_op(ctx: ExecContext):
+    x = ctx.input("X")
+    return {"Out": jnp.power(x, jnp.asarray(ctx.attr("factor", 1.0), x.dtype))}
+
+
+@register_op("isfinite", no_grad=True)
+def isfinite(ctx: ExecContext):
+    # reference isfinite_op.cc reduces to a single bool
+    return {"Out": jnp.all(jnp.isfinite(ctx.input("X"))).reshape(1)}

@@ -1,0 +1,425 @@
+"""Neural-net ops: conv, pooling, normalization, dropout, softmax, losses,
+embedding lookup.
+
+TPU-native equivalents of /root/reference/paddle/fluid/operators/ conv_op.*,
+pool_op.*, batch_norm_op.*, layer_norm_op.*, group_norm_op.cc, dropout_op.*,
+softmax_op.*, cross_entropy_op.*, softmax_with_cross_entropy_op.*,
+lookup_table_op.*, metrics/accuracy_op.cc, smooth_l1_loss_op, sigmoid_xent.
+
+Layout: NCHW to match the reference's Python API contract; XLA relayouts to
+TPU-preferred internally. Matmuls/convs accumulate in fp32
+(`preferred_element_type`) so bf16 training keeps fp32 accumulation on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_op, register_grad_compute
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+@register_op("conv2d")
+def conv2d(ctx: ExecContext):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    d = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx: ExecContext):
+    # reference conv_op.cc registers depthwise as its own type; groups == C_in
+    return conv2d(ctx)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx: ExecContext):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    d = _pair(ctx.attr("dilations", [1, 1]))
+    # filter layout for transpose in the reference is (C_in, C_out, H, W)
+    out = jax.lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    ).astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def pool2d(ctx: ExecContext):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    k = _pair(ctx.attr("ksize", [2, 2]))
+    s = _pair(ctx.attr("strides", [2, 2]))
+    p = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        k = (x.shape[2], x.shape[3])
+        s, p = k, (0, 0)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+        if ctx.attr("exclusive", True) and (p[0] or p[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            out = summed / counts
+        else:
+            out = summed / (k[0] * k[1])
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("softmax")
+def softmax(ctx: ExecContext):
+    return {"Out": jax.nn.softmax(ctx.input("X"), axis=ctx.attr("axis", -1))}
+
+
+@register_op("log_softmax")
+def log_softmax(ctx: ExecContext):
+    return {"Out": jax.nn.log_softmax(ctx.input("X"), axis=ctx.attr("axis", -1))}
+
+
+def _xent_from_softmax(sm, label, soft_label, ignore_index):
+    eps = 1e-12
+    if soft_label:
+        return -jnp.sum(label * jnp.log(sm + eps), axis=-1, keepdims=True)
+    lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+    picked = jnp.take_along_axis(sm, lbl[..., None].astype(np.int32), axis=-1)
+    loss = -jnp.log(picked + eps)
+    if ignore_index is not None and ignore_index >= 0:
+        loss = jnp.where(lbl[..., None] == ignore_index, jnp.zeros_like(loss), loss)
+    return loss
+
+
+@register_op("cross_entropy")
+def cross_entropy(ctx: ExecContext):
+    x, label = ctx.input("X"), ctx.input("Label")
+    return {
+        "Y": _xent_from_softmax(
+            x, label, ctx.attr("soft_label", False), ctx.attr("ignore_index", -100)
+        )
+    }
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(ctx: ExecContext):
+    logits, label = ctx.input("Logits"), ctx.input("Label")
+    soft = ctx.attr("soft_label", False)
+    ignore = ctx.attr("ignore_index", -100)
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    sm = jnp.exp(lsm)
+    if soft:
+        loss = -jnp.sum(label * lsm, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+        loss = -jnp.take_along_axis(lsm, lbl[..., None].astype(np.int32), axis=-1)
+        if ignore >= 0:
+            loss = jnp.where(lbl[..., None] == ignore, jnp.zeros_like(loss), loss)
+    return {"Softmax": sm, "Loss": loss}
+
+
+@register_grad_compute("softmax_with_cross_entropy")
+def softmax_with_cross_entropy_grad(ctx: ExecContext):
+    """dLogits = (softmax - onehot(label)) * dLoss — the classic fused form
+    (reference softmax_with_cross_entropy_op.cu)."""
+    sm = ctx.input("Softmax")
+    label = ctx.input("Label")
+    dloss = ctx.input("Loss@GRAD")
+    if ctx.attr("soft_label", False):
+        grad = (sm - label) * dloss
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+        onehot = jax.nn.one_hot(lbl, sm.shape[-1], dtype=sm.dtype)
+        grad = (sm - onehot) * dloss
+        ignore = ctx.attr("ignore_index", -100)
+        if ignore >= 0:
+            grad = jnp.where((lbl == ignore)[..., None], jnp.zeros_like(grad), grad)
+    return {"Logits@GRAD": grad}
+
+
+def softmax_with_cross_entropy_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    logits = op.input("Logits")[0]
+    if logits in no_grad_set:
+        return []
+    return [
+        {
+            "type": "softmax_with_cross_entropy_grad",
+            "inputs": {
+                "Softmax": op.output("Softmax"),
+                "Label": op.input("Label"),
+                "Loss@GRAD": [grad_var_name(op.output("Loss")[0])],
+            },
+            "outputs": {"Logits@GRAD": [grad_var_name(logits)]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+# wire the custom maker in (registered after the op exists)
+from .registry import get_op_def  # noqa: E402
+
+get_op_def("softmax_with_cross_entropy").grad_maker = softmax_with_cross_entropy_grad_maker
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(ctx: ExecContext):
+    x, label = ctx.input("X"), ctx.input("Label")
+    # numerically stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = ctx.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, jnp.zeros_like(loss), loss)
+    if ctx.attr("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / n
+    return {"Out": loss}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if ctx.has_input("InsideWeight"):
+        d = d * ctx.input("InsideWeight")
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    if ctx.has_input("OutsideWeight"):
+        loss = loss * ctx.input("OutsideWeight")
+    return {"Out": jnp.sum(loss, axis=-1, keepdims=True), "Diff": d}
+
+
+@register_op("batch_norm", stateful_outputs=("MeanOut", "VarianceOut"))
+def batch_norm(ctx: ExecContext):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    bshape = [1] * x.ndim
+    bshape[1 if layout == "NCHW" else x.ndim - 1] = -1
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        xf = x.astype(jnp.float32)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(use_mean)
+        mean_out = mean * momentum + use_mean.astype(mean.dtype) * (1 - momentum)
+        var_out = var * momentum + use_var.astype(var.dtype) * (1 - momentum)
+        saved_mean = use_mean.astype(mean.dtype)
+        saved_var = (1.0 / jnp.sqrt(use_var + eps)).astype(var.dtype)
+    inv = 1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps)
+    y = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y.astype(x.dtype),
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(ctx: ExecContext):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    norm_shape = x.shape[begin:]
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(norm_shape).astype(jnp.float32)
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(norm_shape).astype(jnp.float32)
+    return {
+        "Y": y.astype(x.dtype),
+        "Mean": mean.reshape(x.shape[:begin]).astype(jnp.float32),
+        "Variance": var.reshape(x.shape[:begin]).astype(jnp.float32),
+    }
+
+
+@register_op("group_norm")
+def group_norm(ctx: ExecContext):
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape(n, groups, c // groups, *x.shape[2:]).astype(jnp.float32)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(bshape)
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(bshape)
+    return {
+        "Y": y.astype(x.dtype),
+        "Mean": mean.reshape(n, groups),
+        "Variance": var.reshape(n, groups),
+    }
+
+
+@register_op("instance_norm")
+def instance_norm(ctx: ExecContext):
+    x = ctx.input("X")  # NCHW
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    c = x.shape[1]
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(bshape)
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(bshape)
+    return {"Y": y.astype(x.dtype)}
+
+
+@register_op("dropout", needs_rng=True)
+def dropout(ctx: ExecContext):
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if ctx.attr("is_test", False):
+        if impl == "upscale_in_train":
+            return {"Out": x, "Mask": jnp.ones_like(x)}
+        return {"Out": x * jnp.asarray(1.0 - p, x.dtype), "Mask": jnp.ones_like(x)}
+    keep = jax.random.bernoulli(ctx.rng, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        mask = keep.astype(x.dtype) / jnp.asarray(max(1.0 - p, 1e-8), x.dtype)
+    else:
+        mask = keep.astype(x.dtype)
+    return {"Out": x * mask, "Mask": mask}
+
+
+@register_grad_compute("dropout")
+def dropout_grad(ctx: ExecContext):
+    return {"X@GRAD": ctx.input("Out@GRAD") * ctx.input("Mask")}
+
+
+def dropout_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return []
+    return [
+        {
+            "type": "dropout_grad",
+            "inputs": {
+                "Mask": op.output("Mask"),
+                "Out@GRAD": [grad_var_name(op.output("Out")[0])],
+            },
+            "outputs": {"X@GRAD": [grad_var_name(x)]},
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+get_op_def("dropout").grad_maker = dropout_grad_maker
+
+
+@register_op("lookup_table")
+def lookup_table(ctx: ExecContext):
+    w, ids = ctx.input("W"), ctx.input("Ids")
+    idsq = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    out = jnp.take(w, idsq.astype(np.int32), axis=0)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((idsq == padding_idx)[..., None], jnp.zeros_like(out), out)
+    return {"Out": out}
+
+
+@register_op("lookup_table_v2")
+def lookup_table_v2(ctx: ExecContext):
+    return lookup_table(ctx)
+
+
+@register_op("accuracy", grad="none")
+def accuracy(ctx: ExecContext):
+    idx, label = ctx.input("Indices"), ctx.input("Label")
+    lbl = label.reshape(-1, 1)
+    correct = jnp.any(idx == lbl, axis=1)
+    num_correct = jnp.sum(correct.astype(np.int32))
+    total = jnp.asarray(lbl.shape[0], np.int32)
+    return {
+        "Accuracy": (num_correct / total).astype(np.float32).reshape(1),
+        "Correct": num_correct.reshape(1),
+        "Total": total.reshape(1),
+    }
+
+
+@register_op("label_smooth")
+def label_smooth(ctx: ExecContext):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 0.0)
+    if ctx.has_input("PriorDist"):
+        prior = ctx.input("PriorDist")
+        return {"Out": (1 - eps) * x + eps * prior}
+    return {"Out": (1 - eps) * x + eps / x.shape[-1]}
+
+
+@register_op("prelu")
+def prelu(ctx: ExecContext):
+    x, alpha = ctx.input("X"), ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": jnp.where(x >= 0, x, x * a)}
+
+
+@register_op("softmax_mask_fuse_upper_triangle")
+def softmax_mask_fuse_upper_triangle(ctx: ExecContext):
+    """Causal-masked softmax — fused attention helper (TPU-first addition)."""
+    x = ctx.input("X")
+    q, k = x.shape[-2], x.shape[-1]
+    mask = jnp.tril(jnp.ones((q, k), bool))
+    neg = jnp.asarray(-1e9 if x.dtype != jnp.float16 else -6e4, x.dtype)
+    return {"Out": jax.nn.softmax(jnp.where(mask, x, neg), axis=-1)}

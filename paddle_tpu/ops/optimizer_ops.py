@@ -1,0 +1,246 @@
+"""Optimizer update ops.
+
+TPU-native equivalents of /root/reference/paddle/fluid/operators/optimizers/
+(sgd_op.cc, momentum_op.cc, adam_op.cc, adamax_op.cc, adagrad_op.cc,
+rmsprop_op.cc, adadelta_op.cc, ftrl_op.cc, lamb_op.cc, lars_momentum_op.cc,
+decayed_adagrad_op.cc). Each is a pure function param/state -> new param/state;
+the executor writes outputs back to the same Scope entries (the in-place
+ParamOut contract), with XLA buffer donation so updates happen in-place in HBM.
+
+All moment arithmetic runs in fp32 even when params are bf16 (master-weight
+behaviour lives in the AMP decorator, contrib/mixed_precision).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import ExecContext, register_op
+
+
+def _lr(ctx):
+    lr = ctx.input("LearningRate")
+    return lr.reshape(()) if lr.ndim else lr
+
+
+@register_op("sgd", grad="none", stateful_outputs=("ParamOut",))
+def sgd(ctx: ExecContext):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    return {"ParamOut": p - (_lr(ctx) * g).astype(p.dtype)}
+
+
+@register_op("momentum", grad="none", stateful_outputs=("ParamOut", "VelocityOut"))
+def momentum(ctx: ExecContext):
+    p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
+    mu = ctx.attr("mu")
+    lr = _lr(ctx)
+    v_new = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new.astype(p.dtype), "VelocityOut": v_new.astype(v.dtype)}
+
+
+@register_op(
+    "adam",
+    grad="none",
+    stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
+)
+def adam(ctx: ExecContext):
+    p = ctx.input("Param")
+    g = ctx.input("Grad").astype(jnp.float32)
+    m1 = ctx.input("Moment1")
+    m2 = ctx.input("Moment2")
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b2p = ctx.input("Beta2Pow").reshape(())
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    p_new = p.astype(jnp.float32) - lr * (m1n / (jnp.sqrt(m2n) + eps))
+    return {
+        "ParamOut": p_new.astype(p.dtype),
+        "Moment1Out": m1n,
+        "Moment2Out": m2n,
+        "Beta1PowOut": (b1p * b1).reshape(ctx.input("Beta1Pow").shape),
+        "Beta2PowOut": (b2p * b2).reshape(ctx.input("Beta2Pow").shape),
+    }
+
+
+@register_op("adamax", grad="none", stateful_outputs=("ParamOut", "MomentOut", "InfNormOut"))
+def adamax(ctx: ExecContext):
+    p = ctx.input("Param")
+    g = ctx.input("Grad").astype(jnp.float32)
+    m = ctx.input("Moment")
+    inf = ctx.input("InfNorm")
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_new = p.astype(jnp.float32) - (_lr(ctx) / (1 - b1p)) * (m_new / (inf_new + eps))
+    return {
+        "ParamOut": p_new.astype(p.dtype),
+        "MomentOut": m_new,
+        "InfNormOut": inf_new,
+    }
+
+
+@register_op("adagrad", grad="none", stateful_outputs=("ParamOut", "MomentOut"))
+def adagrad(ctx: ExecContext):
+    p = ctx.input("Param")
+    g = ctx.input("Grad").astype(jnp.float32)
+    m = ctx.input("Moment")
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = m + jnp.square(g)
+    p_new = p.astype(jnp.float32) - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "MomentOut": m_new}
+
+
+@register_op("decayed_adagrad", grad="none", stateful_outputs=("ParamOut", "MomentOut"))
+def decayed_adagrad(ctx: ExecContext):
+    p = ctx.input("Param")
+    g = ctx.input("Grad").astype(jnp.float32)
+    m = ctx.input("Moment")
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    p_new = p.astype(jnp.float32) - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "MomentOut": m_new}
+
+
+@register_op(
+    "adadelta", grad="none", stateful_outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut")
+)
+def adadelta(ctx: ExecContext):
+    p = ctx.input("Param")
+    g = ctx.input("Grad").astype(jnp.float32)
+    ag = ctx.input("AvgSquaredGrad")
+    au = ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    ag_new = rho * ag + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((au + eps) / (ag_new + eps)) * g
+    au_new = rho * au + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": (p.astype(jnp.float32) + update).astype(p.dtype),
+        "AvgSquaredGradOut": ag_new,
+        "AvgSquaredUpdateOut": au_new,
+    }
+
+
+@register_op(
+    "rmsprop", grad="none", stateful_outputs=("ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut")
+)
+def rmsprop(ctx: ExecContext):
+    p = ctx.input("Param")
+    g = ctx.input("Grad").astype(jnp.float32)
+    mom = ctx.input("Moment")
+    ms = ctx.input("MeanSquare")
+    rho = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    momentum = ctx.attr("momentum", 0.0)
+    lr = _lr(ctx)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if ctx.attr("centered", False):
+        mg = ctx.input("MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        denom = ms_new - jnp.square(mg_new) + eps
+    else:
+        mg_new = ctx.input("MeanGrad")
+        denom = ms_new + eps
+    mom_new = momentum * mom + lr * g / jnp.sqrt(denom)
+    out = {
+        "ParamOut": (p.astype(jnp.float32) - mom_new).astype(p.dtype),
+        "MomentOut": mom_new,
+        "MeanSquareOut": ms_new,
+    }
+    if mg_new is not None:
+        out["MeanGradOut"] = mg_new
+    return out
+
+
+@register_op("ftrl", grad="none", stateful_outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
+def ftrl(ctx: ExecContext):
+    p = ctx.input("Param").astype(jnp.float32)
+    g = ctx.input("Grad").astype(jnp.float32)
+    sq = ctx.input("SquaredAccumulator")
+    lin = ctx.input("LinearAccumulator")
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ctx)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre_shrink = (l1 * jnp.sign(new_lin) - new_lin) / denom
+    p_new = jnp.where(jnp.abs(new_lin) > l1, pre_shrink, jnp.zeros_like(p))
+    return {
+        "ParamOut": p_new.astype(ctx.input("Param").dtype),
+        "SquaredAccumOut": new_sq,
+        "LinearAccumOut": new_lin,
+    }
+
+
+@register_op(
+    "lamb",
+    grad="none",
+    stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
+)
+def lamb(ctx: ExecContext):
+    p = ctx.input("Param").astype(jnp.float32)
+    g = ctx.input("Grad").astype(jnp.float32)
+    m1, m2 = ctx.input("Moment1"), ctx.input("Moment2")
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b2p = ctx.input("Beta2Pow").reshape(())
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-6)
+    wd = ctx.attr("weight_decay", 0.01)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    mhat = m1n / (1 - b1p)
+    vhat = m2n / (1 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_new = p - _lr(ctx) * trust * r
+    return {
+        "ParamOut": p_new.astype(ctx.input("Param").dtype),
+        "Moment1Out": m1n,
+        "Moment2Out": m2n,
+        "Beta1PowOut": (b1p * b1).reshape(ctx.input("Beta1Pow").shape),
+        "Beta2PowOut": (b2p * b2).reshape(ctx.input("Beta2Pow").shape),
+    }
+
+
+@register_op("lars_momentum", grad="none", stateful_outputs=("ParamOut", "VelocityOut"))
+def lars_momentum(ctx: ExecContext):
+    p = ctx.input("Param").astype(jnp.float32)
+    g = ctx.input("Grad").astype(jnp.float32)
+    v = ctx.input("Velocity")
+    mu = ctx.attr("mu")
+    coeff = ctx.attr("lars_coeff", 0.001)
+    wd = ctx.attr("lars_weight_decay", 0.0005)
+    eps = 1e-9
+    lr = _lr(ctx)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_new = mu * v + local_lr * (g + wd * p)
+    return {
+        "ParamOut": (p - v_new).astype(ctx.input("Param").dtype),
+        "VelocityOut": v_new,
+    }

@@ -1,0 +1,375 @@
+"""Operator registry: JAX-backed op definitions with derived shape inference
+and derived gradients.
+
+TPU-native re-design of the reference's operator machinery:
+  * /root/reference/paddle/fluid/framework/op_registry.h (REGISTER_OPERATOR)
+  * /root/reference/paddle/fluid/framework/operator.cc (kernel dispatch)
+  * /root/reference/paddle/fluid/framework/grad_op_desc_maker.h
+
+Departures, by design:
+  * One implementation per op — a pure JAX function. There is no
+    place/layout/dtype kernel-key dispatch (operator.cc:970): XLA owns layout
+    and fusion; dtype specialization falls out of tracing.
+  * Shape/dtype inference is DERIVED from the compute function via
+    `jax.eval_shape` instead of hand-written InferShape — ops only override
+    `infer` when the rule can't be traced (e.g. data-dependent reshape).
+  * Gradients are DERIVED via `jax.vjp` over the forward compute: every op
+    `foo` automatically has a `foo_grad` whose kernel re-runs the forward
+    under vjp. Because forward and backward live in ONE jitted XLA block,
+    XLA CSE folds the recomputation away (or keeps it as free rematerialization
+    when that saves HBM). Ops override `grad_maker`/register a custom grad
+    only when the math wants a different formula (e.g. softmax_with_xent).
+
+A batch dim of -1 in Variable.shape is lowered through inference with a
+sentinel extent and mapped back, so programs stay batch-size-polymorphic at
+build time (each concrete batch size is a separate XLA compile, cached).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import DType, np_dtype
+
+# sentinel extent substituted for -1 during eval_shape-based inference
+_DYN = 8191
+
+
+class ExecContext:
+    """Runtime view of one op invocation: resolved input arrays + attrs.
+
+    The executor (and eval_shape-based inference) builds one per op. Inputs
+    that name variables absent from the environment resolve to None (the op
+    decides how to treat them, e.g. missing output-grads become zeros).
+    """
+
+    def __init__(self, op, env: dict, rng=None, lowerer=None):
+        self.op = op
+        self.env = env
+        self.rng = rng  # jax PRNG key or None
+        self.lowerer = lowerer  # callable(block_idx) -> python fn, for control flow
+
+    def inputs(self, slot: str):
+        return [self.env.get(n) for n in self.op.inputs.get(slot, [])]
+
+    def input(self, slot: str, idx: int = 0):
+        names = self.op.inputs.get(slot, [])
+        if idx >= len(names):
+            return None
+        return self.env.get(names[idx])
+
+    def has_input(self, slot: str) -> bool:
+        names = self.op.inputs.get(slot, [])
+        return bool(names) and any(n in self.env for n in names)
+
+    def attr(self, name: str, default=None):
+        return self.op.attrs.get(name, default)
+
+
+class OpDef:
+    def __init__(
+        self,
+        type: str,
+        compute: Callable[[ExecContext], dict],
+        infer: Callable | None = None,
+        grad_maker: Callable | None = None,
+        needs_rng: bool = False,
+        no_grad: bool = False,
+        stateful_outputs: tuple = (),
+    ):
+        self.type = type
+        self.compute = compute
+        self.infer = infer
+        self.grad_maker = grad_maker
+        self.needs_rng = needs_rng
+        self.no_grad = no_grad
+        # output slots that alias an input (in-place update contract, e.g.
+        # sgd's ParamOut) — used by the executor for donation bookkeeping
+        self.stateful_outputs = stateful_outputs
+
+
+_REGISTRY: dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    *,
+    infer=None,
+    grad=None,
+    needs_rng=False,
+    no_grad=False,
+    stateful_outputs=(),
+):
+    """Decorator: register `compute` for op `type`.
+
+    grad: None -> derive via vjp; "none" -> non-differentiable;
+          callable -> custom grad maker (op, block, no_grad_set) -> [op spec].
+    """
+
+    def deco(compute):
+        grad_maker = None
+        is_no_grad = no_grad or grad == "none"
+        if callable(grad):
+            grad_maker = grad
+        _REGISTRY[type] = OpDef(
+            type,
+            compute,
+            infer=infer,
+            grad_maker=grad_maker,
+            needs_rng=needs_rng,
+            no_grad=is_no_grad,
+            stateful_outputs=stateful_outputs,
+        )
+        return compute
+
+    return deco
+
+
+def register_grad_compute(fwd_type: str):
+    """Register a hand-written kernel for `<fwd_type>_grad` (overrides vjp)."""
+
+    def deco(compute):
+        _REGISTRY[fwd_type + "_grad"] = OpDef(fwd_type + "_grad", compute, no_grad=True)
+        return compute
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    if type in _REGISTRY:
+        return _REGISTRY[type]
+    if type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY:
+        # derived vjp-based grad kernel, memoized into the registry
+        fwd = _REGISTRY[type[: -len("_grad")]]
+        d = OpDef(type, _make_vjp_grad_compute(fwd), no_grad=True)
+        _REGISTRY[type] = d
+        return d
+    raise KeyError(f"No op registered with type '{type}'")
+
+
+def has_op(type: str) -> bool:
+    try:
+        get_op_def(type)
+        return True
+    except KeyError:
+        return False
+
+
+def all_op_types():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Derived gradient: run the forward under jax.vjp.
+# ---------------------------------------------------------------------------
+
+
+def _make_vjp_grad_compute(fwd: OpDef):
+    def grad_compute(ctx: ExecContext):
+        op = ctx.op
+        fwd_in_slots = [s for s in op.inputs if not s.endswith("@GRAD")]
+        # flatten differentiable (inexact) vs closed-over inputs
+        prim_keys, prims, consts = [], [], {}
+        for s in fwd_in_slots:
+            for i, a in enumerate(ctx.inputs(s)):
+                if a is not None and jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact):
+                    prim_keys.append((s, i))
+                    prims.append(a)
+                else:
+                    consts[(s, i)] = a
+
+        meta = {"widths": None}  # [(slot, n_outputs)] in flattening order
+
+        def fwd_fn(*xs):
+            fake_inputs = {}
+            for (s, i), x in zip(prim_keys, xs):
+                fake_inputs.setdefault(s, {})[i] = x
+            for (s, i), c in consts.items():
+                if c is not None:
+                    fake_inputs.setdefault(s, {})[i] = c
+
+            env = {}
+
+            class _Shim:
+                inputs = {
+                    s: [f"__in_{s}_{i}" for i in sorted(d)]
+                    for s, d in fake_inputs.items()
+                }
+                outputs = {}
+                attrs = op.attrs
+
+            for s, d in fake_inputs.items():
+                for i in sorted(d):
+                    env[f"__in_{s}_{i}"] = d[i]
+            shim_ctx = ExecContext(_Shim, env, rng=None, lowerer=ctx.lowerer)
+            outs = fwd.compute(shim_ctx)
+            widths, flat = [], []
+            for s in sorted(outs):
+                v = outs[s]
+                lst = list(v) if isinstance(v, (list, tuple)) else [v]
+                widths.append((s, len(lst)))
+                flat.extend(lst)
+            meta["widths"] = widths
+            return tuple(flat)
+
+        outs_flat, vjp = jax.vjp(fwd_fn, *prims)
+        # cotangents: supplied @GRAD inputs; zeros for forward outputs the
+        # backward pass never produced a grad for
+        cots, idx = [], 0
+        for s, w in meta["widths"]:
+            gnames = op.inputs.get(s + "@GRAD", [])
+            for j in range(w):
+                o = outs_flat[idx]
+                idx += 1
+                g = ctx.env.get(gnames[j]) if j < len(gnames) else None
+                cots.append(jnp.zeros_like(o) if g is None else jnp.asarray(g, o.dtype))
+        gins = vjp(tuple(cots))
+
+        result = {}
+        for (s, i), g in zip(prim_keys, gins):
+            out_slot = s + "@GRAD"
+            if out_slot in op.outputs:
+                result.setdefault(out_slot, {})[i] = g
+        # collapse index dicts to lists aligned with output name lists
+        final = {}
+        for s, d in result.items():
+            width = len(op.outputs[s])
+            lst = [None] * width
+            for i, g in d.items():
+                if i < width:
+                    lst[i] = g
+            final[s] = lst if width != 1 else lst[0]
+        return final
+
+    return grad_compute
+
+
+def default_grad_maker(op, block, no_grad_set=frozenset()):
+    """Build the generic `<type>_grad` op spec mirroring the forward slots.
+
+    Mirrors the reference's DefaultGradOpDescMaker
+    (/root/reference/paddle/fluid/framework/grad_op_desc_maker.h:159): forward
+    inputs pass through; each forward output slot gets an `@GRAD` input slot;
+    each differentiable forward input slot gets an `@GRAD` output slot.
+    """
+    from ..framework import grad_var_name
+    from ..core.types import is_floating
+
+    inputs = {s: list(ns) for s, ns in op.inputs.items()}
+    for s, ns in op.outputs.items():
+        inputs[s + "@GRAD"] = [grad_var_name(n) for n in ns]
+    outputs = {}
+    for s, ns in op.inputs.items():
+        gns = []
+        for n in ns:
+            try:
+                v = block.var(n)
+                diff = is_floating(v.dtype) and not v.stop_gradient and n not in no_grad_set
+            except KeyError:
+                diff = False
+            gns.append(grad_var_name(n) if diff else "")
+        if any(gns):
+            outputs[s + "@GRAD"] = gns
+    if not outputs:
+        return []
+    return [
+        {
+            "type": op.type + "_grad",
+            "inputs": inputs,
+            "outputs": outputs,
+            "attrs": dict(op.attrs),
+        }
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Derived shape/dtype inference via eval_shape.
+# ---------------------------------------------------------------------------
+
+
+def infer_op(op, block) -> None:
+    """Set shapes/dtypes of `op`'s outputs, creating missing output vars.
+
+    Uses the opdef's custom `infer` when present, else traces the compute with
+    ShapeDtypeStructs (batch dim -1 -> sentinel -> mapped back to -1).
+    """
+    try:
+        opdef = get_op_def(op.type)
+    except KeyError:
+        return  # unknown op (e.g. feed/fetch markers) — nothing to infer
+    if opdef.infer is not None:
+        opdef.infer(op, block)
+        return
+    if op.type.endswith("_grad"):
+        _infer_grad_from_forward(op, block)
+        return
+
+    env = {}
+    for s, names in op.inputs.items():
+        for n in names:
+            if not n:
+                continue
+            try:
+                v = block.var(n)
+            except KeyError:
+                continue
+            shape = tuple(_DYN if d == -1 else d for d in v.shape)
+            env[n] = jax.ShapeDtypeStruct(shape, np_dtype(v.dtype))
+
+    rng = jax.ShapeDtypeStruct((2,), np.uint32) if opdef.needs_rng else None
+
+    def f(env_vals, key):
+        local = dict(zip(env.keys(), env_vals))
+        ctx = ExecContext(op, local, rng=key)
+        return opdef.compute(ctx)
+
+    try:
+        out = jax.eval_shape(f, tuple(env.values()), rng)
+    except Exception:
+        return  # inference is best-effort; executor will catch real errors
+    _write_inferred(op, block, out)
+
+
+def _write_inferred(op, block, out: dict):
+    for slot, val in out.items():
+        names = op.outputs.get(slot, [])
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for n, sd in zip(names, vals):
+            if not n or sd is None:
+                continue
+            shape = tuple(-1 if d == _DYN else d for d in sd.shape)
+            if n in block.vars:
+                v = block.vars[n]
+                v.shape = shape
+                v.dtype = DType.parse(sd.dtype)
+            else:
+                try:
+                    v = block.var(n)
+                    v.shape = shape
+                    v.dtype = DType.parse(sd.dtype)
+                except KeyError:
+                    block.create_var(name=n, shape=shape, dtype=sd.dtype)
+
+
+def _infer_grad_from_forward(op, block) -> None:
+    """A grad var has the shape/dtype of its forward var."""
+    from ..framework import GRAD_SUFFIX
+
+    for slot, names in op.outputs.items():
+        for n in names:
+            if not n or not n.endswith(GRAD_SUFFIX):
+                continue
+            fwd_name = n[: -len(GRAD_SUFFIX)]
+            try:
+                fv = block.var(fwd_name)
+            except KeyError:
+                continue
+            if n in block.vars:
+                block.vars[n].shape = fv.shape
+                block.vars[n].dtype = fv.dtype
+            else:
+                block.create_var(name=n, shape=fv.shape, dtype=fv.dtype)

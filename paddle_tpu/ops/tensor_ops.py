@@ -1,0 +1,337 @@
+"""Tensor manipulation + creation ops.
+
+TPU-native equivalents of the reference's fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, assign_op.cc, reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, slice_op.cc, squeeze/unsqueeze,
+stack_op.cc, expand_op.cc, gather_op.cc, scatter_op.cc, cum_op, arg_min_max,
+top_k_op.cc, one_hot_op.cc, range_op.cc, compare/logical ops, shape_op.cc
+(/root/reference/paddle/fluid/operators/). Random ops use JAX's counter-based
+PRNG (key threaded by the executor) rather than a stateful generator — that is
+what keeps them safe under XLA tracing and SPMD sharding.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import np_dtype
+from .registry import ExecContext, register_op
+
+
+@register_op("fill_constant", grad="none")
+def fill_constant(ctx: ExecContext):
+    shape = tuple(ctx.attr("shape", []))
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jnp.full(shape, ctx.attr("value", 0.0), dtype)}
+
+
+@register_op("fill_zeros_like", grad="none")
+def fill_zeros_like(ctx: ExecContext):
+    return {"Out": jnp.zeros_like(ctx.input("X"))}
+
+
+@register_op("fill_any_like", grad="none")
+def fill_any_like(ctx: ExecContext):
+    return {"Out": jnp.full_like(ctx.input("X"), ctx.attr("value", 0.0))}
+
+
+@register_op("uniform_random", grad="none", needs_rng=True)
+def uniform_random(ctx: ExecContext):
+    shape = tuple(ctx.attr("shape"))
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    return {"Out": jax.random.uniform(ctx.rng, shape, jnp.float32, lo, hi).astype(dtype)}
+
+
+@register_op("gaussian_random", grad="none", needs_rng=True)
+def gaussian_random(ctx: ExecContext):
+    shape = tuple(ctx.attr("shape"))
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = jax.random.normal(ctx.rng, shape, jnp.float32) * std + mean
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("truncated_gaussian_random", grad="none", needs_rng=True)
+def truncated_gaussian_random(ctx: ExecContext):
+    shape = tuple(ctx.attr("shape"))
+    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    out = jax.random.truncated_normal(ctx.rng, -2.0, 2.0, shape, jnp.float32)
+    return {"Out": (out * std + mean).astype(dtype)}
+
+
+@register_op("assign")
+def assign(ctx: ExecContext):
+    return {"Out": ctx.input("X")}
+
+
+@register_op("shape", grad="none")
+def shape_op(ctx: ExecContext):
+    return {"Out": jnp.asarray(ctx.input("X").shape, np.int32)}
+
+
+@register_op("reshape2")
+def reshape2(ctx: ExecContext):
+    x = ctx.input("X")
+    shape = list(ctx.attr("shape"))
+    # reference semantics (reshape_op.cc): 0 means "copy this input dim"
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape[: x.ndim])] + [
+        d for d in shape[x.ndim :]
+    ]
+    return {"Out": jnp.reshape(x, shape)}
+
+
+@register_op("flatten2")
+def flatten2(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op("transpose2")
+def transpose2(ctx: ExecContext):
+    return {"Out": jnp.transpose(ctx.input("X"), ctx.attr("axis"))}
+
+
+@register_op("concat")
+def concat(ctx: ExecContext):
+    xs = [x for x in ctx.inputs("X") if x is not None]
+    return {"Out": jnp.concatenate(xs, axis=ctx.attr("axis", 0))}
+
+
+@register_op("split")
+def split(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("slice")
+def slice_op(ctx: ExecContext):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        en = max(en + dim, 0) if en < 0 else min(en, dim)
+        idx[ax] = slice(st, en)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("strided_slice")
+def strided_slice(ctx: ExecContext):
+    x = ctx.input("Input")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(
+        ctx.attr("axes"), ctx.attr("starts"), ctx.attr("ends"), ctx.attr("strides")
+    ):
+        idx[ax] = slice(st, en, sd)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("squeeze2")
+def squeeze2(ctx: ExecContext):
+    x = ctx.input("X")
+    axes = ctx.attr("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(x)}
+    return {"Out": jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ctx: ExecContext):
+    x = ctx.input("X")
+    for a in sorted(ctx.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("stack")
+def stack(ctx: ExecContext):
+    xs = [x for x in ctx.inputs("X") if x is not None]
+    return {"Y": jnp.stack(xs, axis=ctx.attr("axis", 0))}
+
+
+@register_op("unstack")
+def unstack(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]}
+
+
+@register_op("expand")
+def expand(ctx: ExecContext):
+    x = ctx.input("X")
+    times = ctx.attr("expand_times")
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("gather")
+def gather(ctx: ExecContext):
+    x, idx = ctx.input("X"), ctx.input("Index")
+    return {"Out": jnp.take(x, idx.reshape(-1), axis=0)}
+
+
+@register_op("gather_nd")
+def gather_nd(ctx: ExecContext):
+    x, idx = ctx.input("X"), ctx.input("Index")
+    return {"Out": x[tuple(jnp.moveaxis(idx, -1, 0))]}
+
+
+@register_op("scatter")
+def scatter(ctx: ExecContext):
+    x, ids, upd = ctx.input("X"), ctx.input("Ids"), ctx.input("Updates")
+    ids = ids.reshape(-1)
+    if ctx.attr("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    return {"Out": x.at[ids].add(upd)}
+
+
+@register_op("cum", grad=None)
+def cumsum(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    out = jnp.cumsum(jnp.flip(x, axis) if ctx.attr("reverse", False) else x, axis=axis)
+    if ctx.attr("reverse", False):
+        out = jnp.flip(out, axis)
+    if ctx.attr("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)[
+            tuple(slice(0, -1) if i == axis % x.ndim else slice(None) for i in range(x.ndim))
+        ]
+    return {"Out": out}
+
+
+@register_op("arg_max", grad="none")
+def arg_max(ctx: ExecContext):
+    return {"Out": jnp.argmax(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(np.int64)}
+
+
+@register_op("arg_min", grad="none")
+def arg_min(ctx: ExecContext):
+    return {"Out": jnp.argmin(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(np.int64)}
+
+
+@register_op("top_k", grad="none")
+def top_k(ctx: ExecContext):
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(np.int64)}
+
+
+@register_op("one_hot", grad="none")
+def one_hot(ctx: ExecContext):
+    x = ctx.input("X")
+    depth = ctx.attr("depth")
+    x = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": jax.nn.one_hot(x, depth, dtype=np.float32)}
+
+
+@register_op("range", grad="none")
+def range_op(ctx: ExecContext):
+    start, end, step = ctx.attr("start"), ctx.attr("end"), ctx.attr("step")
+    dtype = np_dtype(ctx.attr("dtype", "int64"))
+    return {"Out": jnp.arange(start, end, step, dtype)}
+
+
+@register_op("increment")
+def increment(ctx: ExecContext):
+    x = ctx.input("X")
+    return {"Out": x + jnp.asarray(ctx.attr("step", 1.0), x.dtype)}
+
+
+@register_op("pad2d")
+def pad2d(ctx: ExecContext):
+    x = ctx.input("X")
+    p = ctx.attr("paddings")  # [top, bottom, left, right], NCHW
+    mode = ctx.attr("mode", "constant")
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pads, constant_values=ctx.attr("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pads, mode=jmode)}
+
+
+@register_op("pad")
+def pad(ctx: ExecContext):
+    x = ctx.input("X")
+    p = ctx.attr("paddings")
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=ctx.attr("pad_value", 0.0))}
+
+
+# -- comparison / logical (no grad) -----------------------------------------
+def _cmp(fn):
+    def compute(ctx: ExecContext):
+        x, y = ctx.input("X"), ctx.input("Y")
+        return {"Out": fn(x, y)}
+
+    return compute
+
+
+register_op("equal", grad="none")(_cmp(jnp.equal))
+register_op("not_equal", grad="none")(_cmp(jnp.not_equal))
+register_op("less_than", grad="none")(_cmp(jnp.less))
+register_op("less_equal", grad="none")(_cmp(jnp.less_equal))
+register_op("greater_than", grad="none")(_cmp(jnp.greater))
+register_op("greater_equal", grad="none")(_cmp(jnp.greater_equal))
+register_op("logical_and", grad="none")(_cmp(jnp.logical_and))
+register_op("logical_or", grad="none")(_cmp(jnp.logical_or))
+register_op("logical_xor", grad="none")(_cmp(jnp.logical_xor))
+
+
+@register_op("logical_not", grad="none")
+def logical_not(ctx: ExecContext):
+    return {"Out": jnp.logical_not(ctx.input("X"))}
+
+
+@register_op("where")
+def where(ctx: ExecContext):
+    return {"Out": jnp.where(ctx.input("Condition"), ctx.input("X"), ctx.input("Y"))}
+
+
+@register_op("argsort", grad="none")
+def argsort(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(np.int64)}
+
+
+@register_op("linspace", grad="none")
+def linspace(ctx: ExecContext):
+    return {
+        "Out": jnp.linspace(
+            ctx.attr("start"), ctx.attr("stop"), ctx.attr("num"),
+            dtype=np_dtype(ctx.attr("dtype", "float32")),
+        )
+    }
+
+
+@register_op("assign_value", grad="none")
+def assign_value(ctx: ExecContext):
+    vals = np.asarray(ctx.attr("values"), np_dtype(ctx.attr("dtype", "float32")))
+    return {"Out": jnp.asarray(vals.reshape(ctx.attr("shape")))}
+
+
+@register_op("fill_constant_batch_size_like", grad="none")
+def fill_constant_batch_size_like(ctx: ExecContext):
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
+    return {"Out": jnp.full(shape, ctx.attr("value", 0.0), np_dtype(ctx.attr("dtype", "float32")))}

@@ -1,0 +1,649 @@
+"""Optimizers: program transformation appending per-param update ops.
+
+TPU-native re-design of /root/reference/python/paddle/fluid/optimizer.py
+(Optimizer.minimize:586 = backward:442 + apply_gradients:502;
+_create_optimization_pass:339; SGD/Momentum/Adagrad/Adam/Adamax/DecayedAdagrad/
+Adadelta/RMSProp/Ftrl/Lamb:627-2263; ExponentialMovingAverage:2453;
+ModelAverage:2263). Contract kept: `minimize(loss)` appends grad ops (via
+append_backward) then one optimizer op per parameter, with accumulator
+variables created in both main and startup programs. The reference's
+fuse_optimizer_ops pass is unnecessary — all update ops live in one XLA block
+and fuse at compile time.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import unique_name
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .framework import Program, Variable, default_main_program, default_startup_program
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "SGD",
+    "SGDOptimizer",
+    "Momentum",
+    "MomentumOptimizer",
+    "Adagrad",
+    "AdagradOptimizer",
+    "Adam",
+    "AdamOptimizer",
+    "Adamax",
+    "AdamaxOptimizer",
+    "DecayedAdagrad",
+    "DecayedAdagradOptimizer",
+    "Adadelta",
+    "AdadeltaOptimizer",
+    "RMSProp",
+    "RMSPropOptimizer",
+    "Ftrl",
+    "FtrlOptimizer",
+    "Lamb",
+    "LambOptimizer",
+    "LarsMomentum",
+    "LarsMomentumOptimizer",
+    "ExponentialMovingAverage",
+]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:60)."""
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate = learning_rate
+        self._learning_rate_map: dict[Program, Variable] = {}
+        # accumulator name -> {param name -> Variable}
+        self._accumulators: dict[str, dict[str, Variable]] = {}
+        self.helper: LayerHelper | None = None
+
+    # -- learning rate ------------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            # a scheduler already produced an LR variable in this program
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        helper = LayerHelper("learning_rate")
+        self._learning_rate_map[program] = helper.create_or_get_global_variable(
+            unique_name.generate("learning_rate"),
+            [1],
+            "float32",
+            initializer=Constant(float(self._learning_rate)),
+        )
+
+    def _global_learning_rate(self, program=None) -> Variable:
+        program = program or default_main_program()
+        return self._learning_rate_map[program]
+
+    def _create_param_lr(self, param):
+        base_lr = self._global_learning_rate()
+        mult = param.optimize_attr.get("learning_rate", 1.0) if param.optimize_attr else 1.0
+        if mult == 1.0:
+            return base_lr
+        from .layers import nn as L
+
+        return L.scale(base_lr, scale=float(mult))
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype="float32", fill_value=0.0, shape=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper(name)
+        var = helper.create_or_get_global_variable(
+            unique_name.generate(f"{param.name}_{name}"),
+            shape if shape is not None else list(param.shape),
+            dtype,
+            initializer=Constant(fill_value),
+        )
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- the transformation pipeline ----------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        """clip -> regularize -> per-param update ops (optimizer.py:502)."""
+        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+        return self._create_optimization_pass(params_grads)
+
+    def _create_optimization_pass(self, params_grads):
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(
+            default_main_program().global_block, [p for p, _ in params_grads]
+        )
+        ops = []
+        for param, grad in params_grads:
+            if grad is None or not getattr(param, "trainable", True):
+                continue
+            ops.append(self._append_optimize_op(default_main_program().global_block, (param, grad)))
+        self._finish_update()
+        return ops
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={"ParamOut": [param.name]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        return block.append_op(
+            "momentum",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Velocity": [velocity.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={"ParamOut": [param.name], "VelocityOut": [velocity.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(
+        self,
+        learning_rate,
+        momentum,
+        lars_coeff=0.001,
+        lars_weight_decay=0.0005,
+        regularization=None,
+        name=None,
+    ):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        return block.append_op(
+            "lars_momentum",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Velocity": [velocity.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={"ParamOut": [param.name], "VelocityOut": [velocity.name]},
+            attrs={
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+            },
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        return block.append_op(
+            "adagrad",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        regularization=None,
+        name=None,
+        lazy_mode=False,
+    ):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adam"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        return block.append_op(
+            "adam",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(
+        self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, regularization=None, name=None
+    ):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        return block.append_op(
+            "adamax",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [self._get_accumulator("moment", param).name],
+                "InfNorm": [self._get_accumulator("inf_norm", param).name],
+                "Beta1Pow": [self._get_accumulator("beta1_pow_acc", param).name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "MomentOut": [self._get_accumulator("moment", param).name],
+                "InfNormOut": [self._get_accumulator("inf_norm", param).name],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self):
+        # beta1_pow *= beta1 after each step (reference optimizer.py adamax)
+        block = default_main_program().global_block
+        for param_name, b1p in self._accumulators.get("beta1_pow_acc", {}).items():
+            block.append_op(
+                "scale",
+                inputs={"X": [b1p.name]},
+                outputs={"Out": [b1p.name]},
+                attrs={"scale": self._beta1},
+            )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "decayed_adagrad"
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [moment.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "adadelta"
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        ag = self._get_accumulator("avg_squared_grad", param)
+        au = self._get_accumulator("avg_squared_update", param)
+        return block.append_op(
+            "adadelta",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "AvgSquaredGrad": [ag.name],
+                "AvgSquaredUpdate": [au.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "AvgSquaredGradOut": [ag.name],
+                "AvgSquaredUpdateOut": [au.name],
+            },
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(
+        self,
+        learning_rate,
+        rho=0.95,
+        epsilon=1e-6,
+        momentum=0.0,
+        centered=False,
+        regularization=None,
+        name=None,
+    ):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        mom = self._get_accumulator("momentum", param)
+        ms = self._get_accumulator("mean_square", param)
+        mg = self._get_accumulator("mean_grad", param)
+        return block.append_op(
+            "rmsprop",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment": [mom.name],
+                "MeanSquare": [ms.name],
+                "MeanGrad": [mg.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "MomentOut": [mom.name],
+                "MeanSquareOut": [ms.name],
+                "MeanGradOut": [mg.name],
+            },
+            attrs={
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        return block.append_op(
+            "ftrl",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "SquaredAccumulator": [sq.name],
+                "LinearAccumulator": [lin.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "SquaredAccumOut": [sq.name],
+                "LinearAccumOut": [lin.name],
+            },
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(Optimizer):
+    def __init__(
+        self,
+        learning_rate=0.001,
+        lamb_weight_decay=0.01,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-6,
+        regularization=None,
+        name=None,
+    ):
+        super().__init__(learning_rate, regularization, name)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        return block.append_op(
+            "lamb",
+            inputs={
+                "Param": [param.name],
+                "Grad": [grad.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+                "LearningRate": [self._create_param_lr(param).name],
+            },
+            outputs={
+                "ParamOut": [param.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "weight_decay": self._weight_decay,
+            },
+        )
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference optimizer.py:2453).
+
+    `update()` appends shadow-update ops (+ a step counter) to the main
+    program; `apply(executor)` is a context manager that swaps bias-corrected
+    shadow values into the params in the scope for eval and restores them on
+    exit (the reference does the same via temp programs)."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._shadows: dict[str, Variable] = {}
+        self._step_var: Variable | None = None
+
+    def update(self):
+        block = default_main_program().global_block
+        helper = LayerHelper(self._name)
+        self._step_var = helper.create_or_get_global_variable(
+            f"{self._name}.step", [1], "float32", initializer=Constant(0.0)
+        )
+        block.append_op(
+            "increment",
+            inputs={"X": [self._step_var.name]},
+            outputs={"Out": [self._step_var.name]},
+            attrs={"step": 1.0},
+        )
+        for param in default_main_program().all_parameters():
+            shadow = helper.create_or_get_global_variable(
+                f"{param.name}.{self._name}", list(param.shape), param.dtype.value
+            )
+            self._shadows[param.name] = shadow
+            # shadow = decay*shadow + (1-decay)*param, as ops
+            tmp = helper.create_variable_for_type_inference(param.dtype)
+            block.append_op(
+                "scale",
+                inputs={"X": [shadow.name]},
+                outputs={"Out": [tmp.name]},
+                attrs={"scale": self._decay},
+            )
+            tmp2 = helper.create_variable_for_type_inference(param.dtype)
+            block.append_op(
+                "scale",
+                inputs={"X": [param.name]},
+                outputs={"Out": [tmp2.name]},
+                attrs={"scale": 1.0 - self._decay},
+            )
+            block.append_op(
+                "sum", inputs={"X": [tmp.name, tmp2.name]}, outputs={"Out": [shadow.name]}
+            )
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: params <- shadow / (1 - decay^step) in the scope."""
+        import contextlib
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            scope = global_scope()
+            step = float(np.asarray(scope.find_var(self._step_var.name))[0]) if self._step_var else 0.0
+            correction = 1.0 - self._decay ** max(step, 1.0)
+            backup = {}
+            for pname, shadow in self._shadows.items():
+                backup[pname] = scope.find_var(pname)
+                sval = np.asarray(scope.find_var(shadow.name))
+                scope.set_var(pname, (sval / correction).astype(sval.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for pname, val in backup.items():
+                        scope.set_var(pname, val)
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        pass  # restoration handled by the apply() context manager
+
+
+# short aliases matching the reference's public names (optimizer.py:2988+)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

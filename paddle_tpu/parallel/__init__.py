@@ -1,0 +1,11 @@
+from .mesh import (  # noqa: F401
+    CommContext,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    get_comm_context,
+    make_mesh,
+)
+from .sharding import annotate_sharding, build_shardings, var_sharding  # noqa: F401

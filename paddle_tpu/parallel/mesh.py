@@ -1,0 +1,67 @@
+"""Device-mesh management — the TPU-native CommContext.
+
+Replaces the reference's NCCL plumbing (collective_helper.h:62 NCCLCommContext
+ring registry, nccl_helper.h:90 NCCLContextMap): instead of ring_id -> NCCL
+communicator, we keep ring_id/axis-name -> mesh-axis mappings over a
+`jax.sharding.Mesh`. ICI collectives are emitted by XLA from shardings or
+explicit psum/all_gather calls in the collective ops — no runtime comm objects
+exist at all.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "CommContext", "get_comm_context", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "tp"
+SEQ_AXIS = "sp"
+PIPE_AXIS = "pp"
+EXPERT_AXIS = "ep"
+
+
+def make_mesh(shape: dict | None = None, places=None, devices=None) -> Mesh:
+    """Build a Mesh. Default: all devices on one data-parallel axis.
+
+    shape: ordered {axis_name: size} (use -1 for "remaining devices").
+    """
+    devs = devices if devices is not None else jax.devices()
+    if places is not None and not isinstance(places, int):
+        try:
+            devs = list(places)
+        except TypeError:
+            pass
+    elif isinstance(places, int):
+        devs = devs[:places]
+    if not shape:
+        return Mesh(np.array(devs), (DATA_AXIS,))
+    names, sizes = list(shape.keys()), list(shape.values())
+    n = len(devs)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    arr = np.array(devs[: int(np.prod(sizes))]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+class CommContext:
+    """ring_id -> mesh axis registry (facade mirroring NCCLCommContext)."""
+
+    def __init__(self):
+        self._rings: dict[int, str] = {0: DATA_AXIS}
+        self.mesh: Mesh | None = None
+
+    def register_ring(self, ring_id: int, axis: str):
+        self._rings[ring_id] = axis
+
+    def axis_of(self, ring_id: int) -> str:
+        return self._rings.get(ring_id, DATA_AXIS)
+
+
+_ctx = CommContext()
+
+
+def get_comm_context() -> CommContext:
+    return _ctx

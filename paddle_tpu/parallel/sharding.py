@@ -1,0 +1,63 @@
+"""GSPMD sharding assignment for compiled programs.
+
+This module is the TPU-native core of data/model parallelism, replacing the
+reference's multi-device SSA graph construction
+(/root/reference/paddle/fluid/framework/ir/multi_devices_graph_pass/
+multi_devices_graph_pass.cc:169 ApplyImpl, :594 InsertCollectiveOp): instead
+of replicating ops per device and inserting AllReduceOpHandles, every variable
+gets a `NamedSharding` and XLA's SPMD partitioner inserts the collectives.
+
+Rules:
+  * feed (data) vars shard their leading batch dim over the `dp` axis;
+  * params/optimizer state follow their `Variable.sharding` annotation
+    (set by parallel/transpilers or model code for TP/EP), else replicate;
+  * fetches replicate (host reads them).
+Gradient allreduce falls out: batch-sharded activations x replicated params
+=> XLA inserts the psum on the grad path (the AllReduceSSAGraphBuilder
+equivalent, chosen by the compiler not by a pass).
+"""
+from __future__ import annotations
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+__all__ = ["build_shardings", "var_sharding", "annotate_sharding"]
+
+
+def annotate_sharding(var, spec: tuple):
+    """Attach a per-dim mesh-axis annotation to a Variable (TP/SP/EP)."""
+    var.sharding = tuple(spec)
+    return var
+
+
+def var_sharding(mesh: Mesh, var, is_feed: bool) -> NamedSharding:
+    if var is not None and var.sharding is not None:
+        axes = [a if a in mesh.axis_names else None for a in var.sharding]
+        # pad to rank
+        rank = len(var.shape)
+        axes = (list(axes) + [None] * rank)[:rank]
+        return NamedSharding(mesh, P(*axes))
+    if is_feed and var is not None and len(var.shape) >= 1 and DATA_AXIS in mesh.axis_names:
+        spec = [DATA_AXIS] + [None] * (len(var.shape) - 1)
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
+def build_shardings(mesh, block, feed_names, ro_names, rw_names, extra_w, fetch_names):
+    def _var(n):
+        try:
+            return block.var(n)
+        except KeyError:
+            return None
+
+    feed_sh = tuple(var_sharding(mesh, _var(n), True) for n in feed_names)
+    ro_sh = tuple(var_sharding(mesh, _var(n), False) for n in ro_names)
+    rw_sh = tuple(var_sharding(mesh, _var(n), False) for n in rw_names)
+    key_sh = NamedSharding(mesh, P())
+    in_sh = (feed_sh, ro_sh, rw_sh, key_sh)
+    fetch_sh = tuple(NamedSharding(mesh, P()) for _ in fetch_names)
+    new_rw_sh = rw_sh
+    extra_sh = tuple(var_sharding(mesh, _var(n), False) for n in extra_w)
+    out_sh = (fetch_sh, new_rw_sh, extra_sh)
+    return in_sh, out_sh

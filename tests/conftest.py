@@ -1,0 +1,29 @@
+"""Test config: run on an 8-device virtual CPU mesh so sharding/collective
+tests work without TPU hardware (SURVEY.md §4 test strategy — the analogue of
+the reference's localhost multi-process TestDistBase)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs + scope + name generator."""
+    import paddle_tpu as pt
+    from paddle_tpu import unique_name
+    from paddle_tpu.executor import _scope_stack, Scope
+
+    main, startup = pt.Program(), pt.Program()
+    old_main = pt.framework.switch_main_program(main)
+    old_startup = pt.framework.switch_startup_program(startup)
+    old_gen = unique_name.switch()
+    _scope_stack.append(Scope())
+    yield
+    _scope_stack.pop()
+    unique_name.switch(old_gen)
+    pt.framework.switch_main_program(old_main)
+    pt.framework.switch_startup_program(old_startup)

@@ -1,0 +1,131 @@
+"""Program/Block/Operator IR unit tests (reference test pattern:
+python/paddle/fluid/tests/unittests/test_program.py, test_operator_desc.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def test_program_construction_and_shapes():
+    x = L.data(name="x", shape=[13], dtype="float32")
+    y = L.fc(x, size=7)
+    assert y.shape == (-1, 7)
+    prog = pt.default_main_program()
+    assert [op.type for op in prog.global_block.ops] == ["mul", "elementwise_add"]
+    params = prog.all_parameters()
+    assert len(params) == 2
+    assert sorted(tuple(p.shape) for p in params) == [(7,), (13, 7)]
+
+
+def test_program_serialization_roundtrip():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    h = L.fc(x, size=3, act="relu")
+    loss = L.mean(h)
+    prog = pt.default_main_program()
+    d = prog.to_dict()
+    prog2 = pt.Program.from_dict(d)
+    assert [op.type for op in prog2.global_block.ops] == [
+        op.type for op in prog.global_block.ops
+    ]
+    assert prog2.global_block.var("x").shape == (-1, 4)
+
+
+def test_clone_independent():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    h = L.fc(x, size=3)
+    prog = pt.default_main_program()
+    n_ops = len(prog.global_block.ops)
+    clone = prog.clone()
+    with pt.program_guard(clone):
+        L.relu(h)  # appends to clone only... via default program guard
+    assert len(prog.global_block.ops) == n_ops
+
+
+def test_append_backward_creates_grads():
+    x = L.data(name="x", shape=[5], dtype="float32")
+    h = L.fc(x, size=3, act="relu")
+    loss = L.mean(h)
+    pgs = pt.append_backward(loss)
+    assert len(pgs) == 2
+    block = pt.default_main_program().global_block
+    types = [op.type for op in block.ops]
+    assert "mul_grad" in types and "relu_grad" in types and "mean_grad" in types
+    for p, g in pgs:
+        assert g.shape == p.shape
+
+
+def test_shared_weight_grad_accumulates():
+    """Fan-out: one param used twice -> grads summed (reference
+    _addup_repetitive_outputs_ backward.py:135)."""
+    x = L.data(name="x", shape=[4], dtype="float32")
+    w_attr = pt.ParamAttr(name="shared_w")
+    h1 = L.fc(x, size=4, param_attr=w_attr, bias_attr=False)
+    h2 = L.fc(x, size=4, param_attr=w_attr, bias_attr=False)
+    loss = L.mean(h1 + h2)
+    pgs = pt.append_backward(loss)
+    assert len(pgs) == 1
+    types = [op.type for op in pt.default_main_program().global_block.ops]
+    assert "sum" in types
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.ones((2, 4), np.float32)
+    (g,) = exe.run(
+        pt.default_main_program(), feed={"x": xv}, fetch_list=["shared_w@GRAD"]
+    )
+    # d loss / d w for h1 and h2 paths are identical -> grad is twice one path
+    one_path = np.full((4, 4), 1.0 / (2 * 4) * 2, np.float32)  # x=1, mean over 8 elems, 2 rows
+    np.testing.assert_allclose(g, 2 * one_path, rtol=1e-5)
+
+
+def test_stop_gradient_blocks_backward():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    h1 = L.fc(x, size=4, bias_attr=False)
+    h1.stop_gradient = True
+    h2 = L.fc(h1, size=2, bias_attr=False)
+    loss = L.mean(h2)
+    pgs = pt.append_backward(loss)
+    names = [p.name for p, _ in pgs]
+    # first fc's weight gets no grad because h1 blocks the path
+    assert len(pgs) == 1
+
+
+def test_executor_compile_cache_batch_polymorphism():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    y = L.fc(x, size=3)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    out8 = exe.run(pt.default_main_program(), feed={"x": np.zeros((8, 4), np.float32)}, fetch_list=[y])
+    out16 = exe.run(pt.default_main_program(), feed={"x": np.zeros((16, 4), np.float32)}, fetch_list=[y])
+    assert out8[0].shape == (8, 3) and out16[0].shape == (16, 3)
+
+
+def test_square_via_self_mul_grad():
+    """Regression: elementwise_mul(x, x) must produce d/dx = 2x (grads from
+    both input slots of one grad op summed, not overwritten)."""
+    import paddle_tpu.layers.nn as nn
+
+    x = L.data(name="x", shape=[3], dtype="float32")
+    x.stop_gradient = False
+    y = nn._elementwise_binary("elementwise_mul", x, x)
+    loss = L.reduce_sum(y)
+    pt.append_backward(loss, parameter_list=[], no_grad_set=set())
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.array([[1.0, 2.0, 3.0]], np.float32)
+    (g,) = exe.run(pt.default_main_program(), feed={"x": xv}, fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-6)
+
+
+def test_scalar_left_operators():
+    x = L.data(name="x", shape=[2], dtype="float32")
+    a = 1.0 - x
+    b = 2.0 / x
+    c = -x
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.array([[1.0, 4.0]], np.float32)
+    av, bv, cv = exe.run(pt.default_main_program(), feed={"x": xv}, fetch_list=[a, b, c])
+    np.testing.assert_allclose(av, 1.0 - xv)
+    np.testing.assert_allclose(bv, 2.0 / xv)
+    np.testing.assert_allclose(cv, -xv)

@@ -1,0 +1,89 @@
+"""End-to-end slice: MNIST-style MLP trains and loss decreases.
+
+Mirrors the reference's book test (python/paddle/fluid/tests/book/
+test_recognize_digits.py) — build via layers, run startup, train a few
+iterations on synthetic data, assert the loss drops.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def _build_mlp():
+    img = L.data(name="img", shape=[784], dtype="float32")
+    label = L.data(name="label", shape=[1], dtype="int64")
+    h = L.fc(img, size=128, act="relu")
+    h = L.fc(h, size=64, act="relu")
+    logits = L.fc(h, size=10)
+    loss = L.softmax_with_cross_entropy(logits, label)
+    avg_loss = L.mean(loss)
+    acc = L.accuracy(logits, label)
+    return avg_loss, acc
+
+
+def _synthetic_batch(rng, bs=64):
+    x = rng.standard_normal((bs, 784)).astype(np.float32)
+    w = rng.standard_normal((784, 10)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int64)[:, None]
+    return x, y, w
+
+
+def test_mnist_mlp_sgd_loss_decreases():
+    rng = np.random.default_rng(0)
+    avg_loss, acc = _build_mlp()
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+
+    # fixed teacher so the task is learnable
+    x, y, w = _synthetic_batch(rng, bs=128)
+    losses = []
+    for i in range(30):
+        (loss_val,) = exe.run(
+            pt.default_main_program(), feed={"img": x, "label": y}, fetch_list=[avg_loss]
+        )
+        losses.append(float(loss_val))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_mnist_mlp_adam_and_accuracy():
+    rng = np.random.default_rng(1)
+    avg_loss, acc = _build_mlp()
+    opt = pt.optimizer.Adam(learning_rate=1e-3)
+    opt.minimize(avg_loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    x, y, _ = _synthetic_batch(rng, bs=128)
+    first_acc = last = None
+    for i in range(40):
+        loss_val, acc_val = exe.run(
+            pt.default_main_program(),
+            feed={"img": x, "label": y},
+            fetch_list=[avg_loss, acc],
+        )
+        if first_acc is None:
+            first_acc = float(acc_val)
+        last = (float(loss_val), float(acc_val))
+    assert last[1] > max(first_acc, 0.3), (first_acc, last)
+
+
+def test_eval_program_clone_for_test():
+    avg_loss, acc = _build_mlp()
+    test_prog = pt.default_main_program().clone(for_test=True)
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(avg_loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(2)
+    x, y, _ = _synthetic_batch(rng, bs=32)
+    (train_loss,) = exe.run(
+        pt.default_main_program(), feed={"img": x, "label": y}, fetch_list=[avg_loss]
+    )
+    (test_loss,) = exe.run(test_prog, feed={"img": x, "label": y}, fetch_list=[avg_loss.name])
+    assert np.isfinite(test_loss)
