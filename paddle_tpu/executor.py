@@ -127,6 +127,11 @@ def _analyze_block(block, feed_names: list[str], scope: Scope):
     for n in external:
         if n in written_set:
             rw.append(n)
+        elif n.endswith("@GRAD") and not scope.has_var(n):
+            # optional grad input never produced by the backward pass (e.g. a
+            # forward output that doesn't reach the loss): grad kernels treat
+            # a missing cotangent as zeros — don't demand it from the scope
+            continue
         else:
             ro.append(n)
     # persistable outputs that were never read still flow back to the scope

@@ -3,8 +3,19 @@ tests work without TPU hardware (SURVEY.md §4 test strategy — the analogue of
 the reference's localhost multi-process TestDistBase)."""
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-override: the session's sitecustomize registers the axon TPU backend
+# and programmatically sets jax_platforms="axon,cpu" (env vars alone don't
+# win). The unit suite must run on the virtual 8-device CPU mesh, so pin the
+# config before any backend initializes.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", jax.devices()
 
 import numpy as np
 import pytest

@@ -1,0 +1,159 @@
+"""OpTest harness: declarative per-op correctness + gradient checking.
+
+TPU-native clone of the reference's backbone test infrastructure
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py: OpTest:135,
+check_output:544, check_grad:736, get_numeric_gradient:46). Same contract:
+a test declares op_type / inputs / outputs / attrs as numpy; `check_output`
+runs the single op through a real Program+Executor; `check_grad` compares the
+framework's program-transformation gradients against numeric central
+differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework import grad_var_name
+
+
+class OpTest:
+    """Subclass and call setup() then check_output()/check_grad()."""
+
+    op_type: str = ""
+
+    def setup(self, op_type, inputs, outputs, attrs=None):
+        self.op_type = op_type
+        self.inputs = inputs  # slot -> np array | list[(name, np array)]
+        self.expected = outputs  # slot -> np array | list
+        self.attrs = attrs or {}
+
+    # -- helpers ------------------------------------------------------------
+    def _flat_inputs(self):
+        flat = []
+        for slot, v in self.inputs.items():
+            if isinstance(v, list):
+                for name, arr in v:
+                    flat.append((slot, name, np.asarray(arr)))
+            else:
+                flat.append((slot, f"{slot}_in", np.asarray(v)))
+        return flat
+
+    def _build(self):
+        """Build a fresh program containing just this op; returns fetch names."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            block = main.global_block
+            in_names = {}
+            feed = {}
+            for slot, name, arr in self._flat_inputs():
+                block.create_var(
+                    name=name, shape=arr.shape, dtype=str(arr.dtype), is_data=True,
+                    stop_gradient=False,
+                )
+                in_names.setdefault(slot, []).append(name)
+                feed[name] = arr
+            out_names = {}
+            for slot, v in self.expected.items():
+                if isinstance(v, list):
+                    out_names[slot] = [n for n, _ in v]
+                else:
+                    out_names[slot] = [f"{slot}_out"]
+                for n in out_names[slot]:
+                    block.create_var(name=n, shape=(), dtype="float32")
+            block.append_op(self.op_type, in_names, out_names, self.attrs)
+        return main, startup, feed, out_names
+
+    # -- checks -------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, feed, out_names = self._build()
+        exe = pt.Executor()
+        exe.run(startup)
+        fetch = []
+        expected = []
+        for slot, v in self.expected.items():
+            if isinstance(v, list):
+                for n, arr in v:
+                    fetch.append(n)
+                    expected.append(np.asarray(arr))
+            else:
+                fetch.append(out_names[slot][0])
+                expected.append(np.asarray(v))
+        got = exe.run(main, feed=feed, fetch_list=fetch)
+        for g, e, name in zip(got, expected, fetch):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64),
+                np.asarray(e, np.float64),
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"{self.op_type} output {name}",
+            )
+
+    def check_grad(
+        self,
+        inputs_to_check: list[str],
+        output_name: str,
+        numeric_delta=5e-3,
+        max_relative_error=5e-3,
+        no_grad_set=None,
+    ):
+        """Analytic grads (append_backward over a sum-reduced output) vs
+        numeric central differences of the same scalar."""
+        main, startup, feed, out_names = self._build()
+        with pt.program_guard(main, startup):
+            block = main.global_block
+            out_var = block.var(self._out_name(output_name, out_names))
+            from paddle_tpu import layers as L
+
+            target = L.reduce_sum(out_var)
+            pt.append_backward(target, parameter_list=[], no_grad_set=no_grad_set or set())
+        exe = pt.Executor()
+        exe.run(startup)
+        grad_names = [grad_var_name(n) for n in inputs_to_check]
+        analytic = exe.run(main, feed=feed, fetch_list=grad_names)
+
+        # numeric: d sum(out) / d in via central differences
+        fetch_scalar_main, fetch_startup, _, o2 = self._build()
+        with pt.program_guard(fetch_scalar_main, fetch_startup):
+            from paddle_tpu import layers as L
+
+            block = fetch_scalar_main.global_block
+            out_var = block.var(self._out_name(output_name, o2))
+            target2 = L.reduce_sum(out_var)
+        exe2 = pt.Executor()
+        exe2.run(fetch_startup)
+
+        def f(feed_dict):
+            (v,) = exe2.run(fetch_scalar_main, feed=feed_dict, fetch_list=[target2])
+            return float(np.asarray(v))
+
+        for name, a_grad in zip(inputs_to_check, analytic):
+            base = {k: np.array(v, np.float64) for k, v in feed.items()}
+            x = base[name].astype(np.float64)
+            num = np.zeros_like(x)
+            it = np.nditer(x, flags=["multi_index"])
+            while not it.finished:
+                idx = it.multi_index
+                orig = x[idx]
+                x[idx] = orig + numeric_delta
+                base[name] = x.astype(feed[name].dtype)
+                fp = f(base)
+                x[idx] = orig - numeric_delta
+                base[name] = x.astype(feed[name].dtype)
+                fm = f(base)
+                x[idx] = orig
+                base[name] = x.astype(feed[name].dtype)
+                num[idx] = (fp - fm) / (2 * numeric_delta)
+                it.iternext()
+            a = np.asarray(a_grad, np.float64)
+            denom = np.maximum(np.maximum(np.abs(a), np.abs(num)), 1e-3)
+            rel = np.max(np.abs(a - num) / denom)
+            assert rel <= max_relative_error, (
+                f"{self.op_type} grad of {name}: max rel err {rel}\n"
+                f"analytic={a}\nnumeric={num}"
+            )
+
+    def _out_name(self, output_name, out_names):
+        for slot, names in out_names.items():
+            if slot == output_name or output_name in names:
+                return names[0] if slot == output_name else output_name
+        raise KeyError(output_name)

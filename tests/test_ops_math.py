@@ -1,0 +1,294 @@
+"""Per-op correctness + gradient tests (reference pattern: test_*_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reseed():
+    """Each test draws from a freshly-seeded rng so results don't depend on
+    which tests ran before (and failures reproduce in isolation)."""
+    global rng
+    rng = np.random.default_rng(42)
+    yield
+
+
+def _r(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+class TestMul(OpTest):
+    def test_output_and_grad(self):
+        x, y = _r(4, 5), _r(5, 3)
+        self.setup("mul", {"X": x, "Y": y}, {"Out": x @ y},
+                   {"x_num_col_dims": 1, "y_num_col_dims": 1})
+        self.check_output()
+        self.check_grad(["X_in", "Y_in"], "Out")
+
+    def test_4d_flatten(self):
+        x, y = _r(2, 3, 2, 2), _r(12, 4)
+        self.setup("mul", {"X": x, "Y": y}, {"Out": (x.reshape(2, 12) @ y).reshape(2, 4)},
+                   {"x_num_col_dims": 1, "y_num_col_dims": 1})
+        self.check_output()
+
+
+class TestMatmul(OpTest):
+    def test_batched(self):
+        x, y = _r(3, 4, 5), _r(3, 5, 6)
+        self.setup("matmul", {"X": x, "Y": y}, {"Out": np.matmul(x, y)}, {})
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["X_in", "Y_in"], "Out")
+
+    def test_transpose(self):
+        x, y = _r(5, 4), _r(5, 6)
+        self.setup("matmul", {"X": x, "Y": y}, {"Out": x.T @ y}, {"transpose_X": True})
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestElementwise(OpTest):
+    def test_add_broadcast_axis(self):
+        x, y = _r(2, 3, 4), _r(3)
+        self.setup("elementwise_add", {"X": x, "Y": y},
+                   {"Out": x + y.reshape(1, 3, 1)}, {"axis": 1})
+        self.check_output()
+        self.check_grad(["X_in", "Y_in"], "Out")
+
+    def test_mul_same_shape(self):
+        x, y = _r(3, 4), _r(3, 4)
+        self.setup("elementwise_mul", {"X": x, "Y": y}, {"Out": x * y}, {})
+        self.check_output()
+        self.check_grad(["X_in", "Y_in"], "Out")
+
+    def test_div(self):
+        x = _r(3, 4)
+        y = np.abs(_r(3, 4)) + 1.0
+        self.setup("elementwise_div", {"X": x, "Y": y}, {"Out": x / y}, {})
+        self.check_output()
+        self.check_grad(["X_in", "Y_in"], "Out", max_relative_error=1e-2)
+
+
+class TestReduce(OpTest):
+    def test_sum_axis(self):
+        x = _r(3, 4, 5)
+        self.setup("reduce_sum", {"X": x}, {"Out": x.sum(1)}, {"dim": [1], "keep_dim": False})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_mean_all(self):
+        x = _r(3, 4)
+        self.setup("reduce_mean", {"X": x}, {"Out": np.asarray(x.mean())},
+                   {"dim": [0], "reduce_all": True, "keep_dim": False})
+        self.check_output()
+
+    def test_max(self):
+        x = _r(4, 5)
+        self.setup("reduce_max", {"X": x}, {"Out": x.max(1)}, {"dim": [1], "keep_dim": False})
+        self.check_output()
+
+
+class TestActivations(OpTest):
+    def test_relu(self):
+        x = _r(3, 4)
+        x[np.abs(x) < 0.05] += 0.2  # keep away from the kink
+        self.setup("relu", {"X": x}, {"Out": np.maximum(x, 0)}, {})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_sigmoid(self):
+        x = _r(3, 4)
+        self.setup("sigmoid", {"X": x}, {"Out": 1 / (1 + np.exp(-x))}, {})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_tanh_gelu(self):
+        x = _r(3, 4)
+        self.setup("tanh", {"X": x}, {"Out": np.tanh(x)}, {})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_leaky_relu(self):
+        x = _r(3, 4)
+        x[np.abs(x) < 0.05] += 0.2
+        self.setup("leaky_relu", {"X": x}, {"Out": np.where(x >= 0, x, 0.1 * x)}, {"alpha": 0.1})
+        self.check_output()
+
+
+class TestSoftmaxXent(OpTest):
+    def test_softmax(self):
+        x = _r(4, 7)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.setup("softmax", {"X": x}, {"Out": e / e.sum(-1, keepdims=True)}, {"axis": -1})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_softmax_with_cross_entropy(self):
+        logits = _r(5, 10)
+        label = rng.integers(0, 10, (5, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), label[:, 0]])[:, None]
+        self.setup(
+            "softmax_with_cross_entropy",
+            {"Logits": logits, "Label": label},
+            {"Softmax": sm, "Loss": loss},
+            {},
+        )
+        self.check_output(atol=1e-4)
+        self.check_grad(["Logits_in"], "Loss", max_relative_error=3e-2)
+
+    def test_cross_entropy_soft(self):
+        probs = np.abs(_r(4, 6)) + 0.1
+        probs /= probs.sum(-1, keepdims=True)
+        soft = np.abs(_r(4, 6))
+        soft /= soft.sum(-1, keepdims=True)
+        expected = -(soft * np.log(probs + 1e-12)).sum(-1, keepdims=True)
+        self.setup(
+            "cross_entropy",
+            {"X": probs.astype(np.float32), "Label": soft.astype(np.float32)},
+            {"Y": expected},
+            {"soft_label": True},
+        )
+        self.check_output(atol=1e-4)
+
+
+class TestConvPool(OpTest):
+    def test_conv2d(self):
+        import jax
+        x, w = _r(2, 3, 8, 8), _r(4, 3, 3, 3)
+        ref = np.asarray(
+            jax.lax.conv_general_dilated(
+                x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=("NCHW", "OIHW", "NCHW")
+            )
+        )
+        self.setup(
+            "conv2d",
+            {"Input": x, "Filter": w},
+            {"Output": ref},
+            {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1], "groups": 1},
+        )
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["Input_in", "Filter_in"], "Output", max_relative_error=2e-2)
+
+    def test_pool2d_max(self):
+        x = _r(2, 3, 4, 4)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.setup(
+            "pool2d",
+            {"X": x},
+            {"Out": ref},
+            {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+        )
+        self.check_output()
+
+    def test_pool2d_avg(self):
+        x = _r(2, 3, 4, 4)
+        ref = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.setup(
+            "pool2d",
+            {"X": x},
+            {"Out": ref},
+            {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+        )
+        self.check_output()
+
+
+class TestNorms(OpTest):
+    def test_layer_norm(self):
+        x = _r(4, 10)
+        scale = np.abs(_r(10)) + 0.5
+        bias = _r(10)
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.setup(
+            "layer_norm",
+            {"X": x, "Scale": scale, "Bias": bias},
+            {
+                "Y": y,
+                "Mean": mean.reshape(4),
+                "Variance": var.reshape(4),
+            },
+            {"begin_norm_axis": 1, "epsilon": 1e-5},
+        )
+        self.check_output(atol=1e-4)
+        self.check_grad(["X_in", "Scale_in", "Bias_in"], "Y", max_relative_error=2e-2)
+
+    def test_batch_norm_infer(self):
+        x = _r(4, 3, 2, 2)
+        scale, bias = np.abs(_r(3)) + 0.5, _r(3)
+        mean, var = _r(3) * 0.1, np.abs(_r(3)) + 1.0
+        y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(var.reshape(1, 3, 1, 1) + 1e-5)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.setup(
+            "batch_norm",
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+            {"Y": y},
+            {"is_test": True, "epsilon": 1e-5},
+        )
+        self.check_output(atol=1e-4)
+
+
+class TestLookupTable(OpTest):
+    def test_lookup_and_grad(self):
+        w = _r(10, 4)
+        ids = rng.integers(0, 10, (5, 1)).astype(np.int64)
+        self.setup("lookup_table", {"W": w, "Ids": ids}, {"Out": w[ids[:, 0]]}, {})
+        self.check_output()
+        self.check_grad(["W_in"], "Out")
+
+
+class TestTensorOps(OpTest):
+    def test_concat_grad(self):
+        xs = [("a", _r(2, 3)), ("b", _r(2, 5))]
+        self.setup(
+            "concat",
+            {"X": xs},
+            {"Out": np.concatenate([xs[0][1], xs[1][1]], axis=1)},
+            {"axis": 1},
+        )
+        self.check_output()
+        self.check_grad(["a", "b"], "Out")
+
+    def test_split(self):
+        x = _r(4, 6)
+        parts = np.split(x, 3, axis=1)
+        self.setup(
+            "split",
+            {"X": x},
+            {"Out": [("o0", parts[0]), ("o1", parts[1]), ("o2", parts[2])]},
+            {"axis": 1, "num": 3},
+        )
+        self.check_output()
+
+    def test_transpose_reshape(self):
+        x = _r(2, 3, 4)
+        self.setup("transpose2", {"X": x}, {"Out": x.transpose(2, 0, 1)}, {"axis": [2, 0, 1]})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_slice(self):
+        x = _r(4, 5, 6)
+        self.setup(
+            "slice",
+            {"Input": x},
+            {"Out": x[1:3, :, 2:5]},
+            {"axes": [0, 2], "starts": [1, 2], "ends": [3, 5]},
+        )
+        self.check_output()
+        self.check_grad(["Input_in"], "Out")
+
+    def test_gather(self):
+        x = _r(8, 3)
+        idx = np.array([0, 3, 5], np.int64)
+        self.setup("gather", {"X": x, "Index": idx}, {"Out": x[idx]}, {})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
+
+    def test_scale_bias(self):
+        x = _r(3, 4)
+        self.setup("scale", {"X": x}, {"Out": x * 2.5 + 1.0}, {"scale": 2.5, "bias": 1.0})
+        self.check_output()
+        self.check_grad(["X_in"], "Out")
