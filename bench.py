@@ -1,0 +1,91 @@
+"""Benchmark: BERT-style transformer training-step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.45 (the BASELINE.json north-star of >=45% MFU
+on TPU; the reference publishes no training throughput numbers, SURVEY.md §6).
+
+Model FLOPs use the standard 6*N*T transformer estimate plus attention terms
+(12*L*H*S^2*T_layer factor), peak chip FLOP/s from the device kind.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    table = {
+        "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
+        "v5lite": 197e12, "v5p": 459e12, "v5": 197e12,
+        "v4": 275e12, "v3": 123e12, "v2": 45e12, "v6e": 918e12, "v6": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 1e12  # CPU / unknown: nominal
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = transformer.TransformerConfig(
+            vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+            ffn_size=3072, max_position=512, dropout=0.0, use_tp=False)
+        batch, seq_len, iters = 32, 128, 20
+    else:  # dev-box sanity run
+        cfg = transformer.bert_tiny(use_tp=False)
+        batch, seq_len, iters = 8, 32, 5
+
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        avg_loss, _ = transformer.bert_pretrain(cfg, seq_len=seq_len)
+        pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_loss)
+
+    rng = np.random.default_rng(0)
+    feed = {
+        "src_ids": rng.integers(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64),
+        "pos_ids": np.tile(np.arange(seq_len, dtype=np.int64), (batch, 1)),
+        "lm_label": rng.integers(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64),
+        "lm_weight": np.ones((batch, seq_len), np.float32),
+    }
+
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        # warmup/compile
+        exe.run(main_p, feed=feed, fetch_list=[avg_loss])
+        exe.run(main_p, feed=feed, fetch_list=[avg_loss])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            (loss,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
+        dt = (time.perf_counter() - t0) / iters
+
+    tokens = batch * seq_len
+    tok_per_sec = tokens / dt
+
+    # parameter count (embeddings + L layers + head)
+    H, L_, F, V = cfg.hidden_size, cfg.num_layers, cfg.ffn_size, cfg.vocab_size
+    n_params = V * H + cfg.max_position * H + L_ * (4 * H * H + 2 * H * F) + H * V
+    # fwd+bwd matmul flops ~ 6*N*T; attention adds 12*L*H*S^2 per token-pair term
+    step_flops = 6 * n_params * tokens + 12 * L_ * H * seq_len * tokens
+    mfu = (step_flops / dt) / _peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "bert_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

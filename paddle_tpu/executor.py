@@ -304,7 +304,14 @@ class Executor:
                 from jax.experimental.shard_map import shard_map as _shard_map
 
             ctx = get_comm_context()
-            axis_env = {ring: ctx.axis_of(ring) for ring in range(8)}
+            data_axis_name = mesh.axis_names[0]
+            # rings bind to registered axes only when the mesh HAS that axis;
+            # otherwise fall back to the mesh's first (data) axis so psum never
+            # references an unbound axis name
+            axis_env = {}
+            for ring in range(8):
+                ax = ctx.axis_of(ring)
+                axis_env[ring] = ax if ax in mesh.axis_names else data_axis_name
             for ax in mesh.axis_names:
                 axis_env.setdefault(ax, ax)
             fn = _lower(
@@ -332,9 +339,14 @@ class Executor:
                 tuple(P() for _ in rw_names),
                 tuple(P() for _ in extra_w),
             )
-            sfn = _shard_map(
-                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-            )
+            try:
+                sfn = _shard_map(
+                    fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+                )
+            except TypeError:  # older jax spells the kwarg check_rep
+                sfn = _shard_map(
+                    fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+                )
             jfn = jax.jit(sfn, donate_argnums=(2,))
             comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
             comp.extra_w = extra_w

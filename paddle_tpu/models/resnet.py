@@ -1,0 +1,106 @@
+"""ResNet image classification — BASELINE config 2 (reference: fluid
+image_classification book test and the SE-ResNeXt ParallelExecutor tests,
+python/paddle/fluid/tests/unittests/test_parallel_executor_seresnext*.py).
+
+NCHW at the API (reference layers contract); XLA picks the TPU-native layout.
+Data parallelism = batch-dim GSPMD sharding via CompiledProgram — no per-GPU
+graph replication.
+"""
+from __future__ import annotations
+
+from .. import layers as L
+
+__all__ = ["resnet", "resnet50", "resnet18", "resnet_cifar10"]
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _conv_bn(x, ch, k, stride=1, act=None, name=None):
+    y = L.conv2d(x, num_filters=ch, filter_size=k, stride=stride,
+                 padding=(k - 1) // 2, bias_attr=False, name=name)
+    return L.batch_norm(y, act=act, name=(name + ".bn") if name else None)
+
+
+def _shortcut(x, ch_out, stride, name):
+    if x.shape[1] != ch_out or stride != 1:
+        return _conv_bn(x, ch_out, 1, stride, name=name + ".sc")
+    return x
+
+
+def _basic_block(x, ch, stride, name):
+    y = _conv_bn(x, ch, 3, stride, act="relu", name=name + ".c1")
+    y = _conv_bn(y, ch, 3, 1, name=name + ".c2")
+    s = _shortcut(x, ch, stride, name)
+    return L.relu(L.elementwise_add(y, s))
+
+
+def _bottleneck_block(x, ch, stride, name):
+    y = _conv_bn(x, ch, 1, 1, act="relu", name=name + ".c1")
+    y = _conv_bn(y, ch, 3, stride, act="relu", name=name + ".c2")
+    y = _conv_bn(y, ch * 4, 1, 1, name=name + ".c3")
+    s = _shortcut(x, ch * 4, stride, name)
+    return L.relu(L.elementwise_add(y, s))
+
+
+def resnet(img, depth=50, num_classes=1000):
+    """Build the trunk + logits head. img: [N,3,H,W]."""
+    kind, layers_per_stage = _DEPTH_CFG[depth]
+    block = _basic_block if kind == "basic" else _bottleneck_block
+    x = _conv_bn(img, 64, 7, stride=2, act="relu", name="stem")
+    x = L.pool2d(x, pool_size=3, pool_type="max", pool_stride=2, pool_padding=1)
+    for stage, n in enumerate(layers_per_stage):
+        ch = 64 * (2 ** stage)
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            x = block(x, ch, stride, name=f"res{stage}.{i}")
+    x = L.pool2d(x, pool_type="avg", global_pooling=True)
+    return L.fc(x, size=num_classes)
+
+
+def resnet50(img=None, label=None, num_classes=1000, class_dim=None):
+    if class_dim is not None:
+        num_classes = class_dim
+    if img is None:
+        img = L.data(name="img", shape=[3, 224, 224], dtype="float32")
+    if label is None:
+        label = L.data(name="label", shape=[1], dtype="int64")
+    logits = resnet(img, depth=50, num_classes=num_classes)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    acc = L.accuracy(logits, label)
+    return loss, acc, logits
+
+
+def resnet18(img=None, label=None, num_classes=1000):
+    if img is None:
+        img = L.data(name="img", shape=[3, 224, 224], dtype="float32")
+    if label is None:
+        label = L.data(name="label", shape=[1], dtype="int64")
+    logits = resnet(img, depth=18, num_classes=num_classes)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    acc = L.accuracy(logits, label)
+    return loss, acc, logits
+
+
+def resnet_cifar10(img=None, label=None, num_classes=10):
+    """Small 3-stage ResNet for 32x32 inputs (book image_classification)."""
+    if img is None:
+        img = L.data(name="img", shape=[3, 32, 32], dtype="float32")
+    if label is None:
+        label = L.data(name="label", shape=[1], dtype="int64")
+    x = _conv_bn(img, 16, 3, act="relu", name="stem")
+    for stage in range(3):
+        ch = 16 * (2 ** stage)
+        for i in range(3):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            x = _basic_block(x, ch, stride, name=f"res{stage}.{i}")
+    x = L.pool2d(x, pool_type="avg", global_pooling=True)
+    logits = L.fc(x, size=num_classes)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    acc = L.accuracy(logits, label)
+    return loss, acc, logits

@@ -1,0 +1,196 @@
+"""Transformer encoder / BERT-style pretraining — BASELINE configs 3 & 4
+(reference: fluid book machine-translation transformer and ERNIE/BERT built on
+fluid layers; attention primitive at reference python/paddle/fluid/nets.py:345
+scaled_dot_product_attention).
+
+TPU-first design notes:
+  * Megatron-style tensor parallelism comes from GSPMD annotations on the
+    projection weights (SURVEY.md §2.3): QKV/FFN-in shard the output dim over
+    the `tp` mesh axis, attention-out/FFN-out shard the input dim — XLA's
+    sharding propagator inserts the all-reduces the reference would have
+    needed hand-written DistFC logic for.
+  * Sequence parallelism = sharding the sequence dim of the token stream over
+    the `sp` axis; the attention score matmul forces an all-gather that XLA
+    places on ICI.
+  * Everything is static-shaped (padded seq_len); bf16-friendly.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import layers as L
+from ..framework import default_main_program
+from ..param_attr import ParamAttr
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..parallel.sharding import annotate_sharding
+
+__all__ = ["TransformerConfig", "bert_base", "bert_tiny", "transformer_encoder",
+           "bert_pretrain", "multi_head_attention", "positionwise_ffn"]
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_size: int = 3072
+    max_position: int = 512
+    dropout: float = 0.1
+    # parallelism intent: annotate weights/feeds with these mesh axes; harmless
+    # when the program runs on a mesh lacking the axis (annotations filtered)
+    use_tp: bool = True
+    use_sp: bool = False
+    dtype: str = "float32"
+
+
+def bert_base() -> TransformerConfig:
+    return TransformerConfig()
+
+
+def bert_tiny(use_tp: bool = True, use_sp: bool = False) -> TransformerConfig:
+    return TransformerConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                             num_heads=4, ffn_size=128, max_position=64,
+                             dropout=0.0, use_tp=use_tp, use_sp=use_sp)
+
+
+def _annot(spec):
+    """Return a hook that annotates the named main-program var after creation."""
+    def apply(name):
+        block = default_main_program().global_block
+        annotate_sharding(block.var(name), spec)
+    return apply
+
+
+def _fc(x, size, prefix, w_spec=None, b_spec=None, act=None, cfg=None):
+    num_flatten = len(x.shape) - 1
+    w_name, b_name = prefix + ".w", prefix + ".b"
+    out = L.fc(
+        x, size=size, num_flatten_dims=num_flatten,
+        param_attr=ParamAttr(name=w_name), bias_attr=ParamAttr(name=b_name),
+        act=act,
+    )
+    if cfg is not None and cfg.use_tp:
+        if w_spec is not None:
+            _annot(w_spec)(w_name)
+        if b_spec is not None:
+            _annot(b_spec)(b_name)
+    return out
+
+
+def multi_head_attention(x, cfg: TransformerConfig, attn_bias=None, name="attn"):
+    """Self-attention: fused QKV projection, [B,S,H] -> [B,S,H].
+
+    TP: QKV weight [H, 3H] shards dim 1; out-proj [H, H] shards dim 0 — the
+    classic Megatron column/row-parallel pair, expressed as annotations.
+    """
+    B_, S, H = -1, x.shape[-2], cfg.hidden_size
+    nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+    qkv = _fc(x, 3 * H, name + ".qkv", w_spec=(None, MODEL_AXIS),
+              b_spec=(MODEL_AXIS,), cfg=cfg)
+    qkv = L.reshape(qkv, shape=[0, S, 3, nh, dh])
+    qkv = L.transpose(qkv, perm=[2, 0, 3, 1, 4])  # [3, B, nh, S, dh]
+    q = L.squeeze(L.slice(qkv, axes=[0], starts=[0], ends=[1]), axes=[0])
+    k = L.squeeze(L.slice(qkv, axes=[0], starts=[1], ends=[2]), axes=[0])
+    v = L.squeeze(L.slice(qkv, axes=[0], starts=[2], ends=[3]), axes=[0])
+
+    scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)  # [B,nh,S,S]
+    if attn_bias is not None:
+        scores = L.elementwise_add(scores, attn_bias)
+    probs = L.softmax(scores)
+    if cfg.dropout:
+        probs = L.dropout(probs, dropout_prob=cfg.dropout,
+                          dropout_implementation="upscale_in_train")
+    ctxv = L.matmul(probs, v)                     # [B,nh,S,dh]
+    ctxv = L.transpose(ctxv, perm=[0, 2, 1, 3])
+    ctxv = L.reshape(ctxv, shape=[0, S, H])
+    out = _fc(ctxv, H, name + ".out", w_spec=(MODEL_AXIS, None), cfg=cfg)
+    return out
+
+
+def positionwise_ffn(x, cfg: TransformerConfig, name="ffn"):
+    h = _fc(x, cfg.ffn_size, name + ".in", w_spec=(None, MODEL_AXIS),
+            b_spec=(MODEL_AXIS,), act="gelu", cfg=cfg)
+    if cfg.dropout:
+        h = L.dropout(h, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    return _fc(h, cfg.hidden_size, name + ".out", w_spec=(MODEL_AXIS, None), cfg=cfg)
+
+
+def _encoder_layer(x, cfg: TransformerConfig, attn_bias, name):
+    # post-LN as in BERT/original transformer
+    a = multi_head_attention(x, cfg, attn_bias, name=name + ".mha")
+    if cfg.dropout:
+        a = L.dropout(a, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    x = L.layer_norm(L.elementwise_add(x, a), begin_norm_axis=2,
+                     name=name + ".ln1")
+    f = positionwise_ffn(x, cfg, name=name + ".ffn")
+    if cfg.dropout:
+        f = L.dropout(f, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    return L.layer_norm(L.elementwise_add(x, f), begin_norm_axis=2,
+                        name=name + ".ln2")
+
+
+def transformer_encoder(src_ids, pos_ids, cfg: TransformerConfig,
+                        input_mask=None, name="encoder"):
+    """Token+position embedding -> N encoder layers. Returns [B,S,H]."""
+    emb = L.embedding(src_ids, size=[cfg.vocab_size, cfg.hidden_size],
+                      param_attr=ParamAttr(name=name + ".word_emb"),
+                      dtype=cfg.dtype)
+    pos = L.embedding(pos_ids, size=[cfg.max_position, cfg.hidden_size],
+                      param_attr=ParamAttr(name=name + ".pos_emb"),
+                      dtype=cfg.dtype)
+    x = L.elementwise_add(emb, pos)
+    x = L.layer_norm(x, begin_norm_axis=2, name=name + ".emb_ln")
+    if cfg.dropout:
+        x = L.dropout(x, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+
+    attn_bias = None
+    if input_mask is not None:
+        # input_mask [B,S] 1/0 -> additive bias [B,1,1,S]
+        neg = L.scale(input_mask, scale=-1.0, bias=1.0)
+        neg = L.scale(neg, scale=-1e9)
+        attn_bias = L.unsqueeze(L.unsqueeze(neg, axes=[1]), axes=[1])
+
+    for i in range(cfg.num_layers):
+        x = _encoder_layer(x, cfg, attn_bias, name=f"{name}.layer{i}")
+    return x
+
+
+def bert_pretrain(cfg: TransformerConfig, seq_len: int = 128):
+    """Masked-LM pretraining program: returns (avg_loss, feeds dict).
+
+    Feeds: src_ids, pos_ids [B,S] int64; lm_label [B,S] int64 (ids at masked
+    positions, -ignored elsewhere via mask weighting); lm_weight [B,S] float32.
+    """
+    src_ids = L.data(name="src_ids", shape=[seq_len], dtype="int64")
+    pos_ids = L.data(name="pos_ids", shape=[seq_len], dtype="int64")
+    lm_label = L.data(name="lm_label", shape=[seq_len], dtype="int64")
+    lm_weight = L.data(name="lm_weight", shape=[seq_len], dtype="float32")
+
+    if cfg.use_sp:
+        block = default_main_program().global_block
+        for n in ("src_ids", "pos_ids", "lm_label", "lm_weight"):
+            annotate_sharding(block.var(n), (DATA_AXIS, SEQ_AXIS))
+
+    enc = transformer_encoder(src_ids, pos_ids, cfg)  # [B,S,H]
+    logits = _fc(enc, cfg.vocab_size, "lm_head", w_spec=(None, MODEL_AXIS),
+                 b_spec=(MODEL_AXIS,), cfg=cfg)       # [B,S,V]
+    label = L.unsqueeze(lm_label, axes=[2])
+    loss = L.softmax_with_cross_entropy(logits, label)  # [B,S,1]
+    loss = L.squeeze(loss, axes=[2])
+    weighted = L.elementwise_mul(loss, lm_weight)
+    denom = L.elementwise_add(L.reduce_sum(lm_weight), _const_eps())
+    avg_loss = L.elementwise_div(L.reduce_sum(weighted), denom)
+    feeds = {"src_ids": src_ids, "pos_ids": pos_ids,
+             "lm_label": lm_label, "lm_weight": lm_weight}
+    return avg_loss, feeds
+
+
+def _const_eps():
+    from ..layers.tensor import fill_constant
+    return fill_constant(shape=[], dtype="float32", value=1e-6)

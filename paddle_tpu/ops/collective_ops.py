@@ -42,13 +42,20 @@ def _allreduce(red):
         if axis is None:
             return {"Out": x}  # GSPMD regime: partitioner owns the reduction
         if red == "sum":
-            return {"Out": jax.lax.psum(x, axis)}
+            out = jax.lax.psum(x, axis)
+            if ctx.attr("avg", False):
+                # fused mean-allreduce: the 1/nranks scale lives INSIDE the op
+                # so it only applies when a real reduction happens (a separate
+                # scale op would corrupt grads in the GSPMD identity regime)
+                out = out / jax.lax.axis_size(axis)
+            return {"Out": out}
         if red == "max":
             return {"Out": jax.lax.pmax(x, axis)}
         if red == "min":
             return {"Out": jax.lax.pmin(x, axis)}
         if red == "prod":
-            return {"Out": jnp.exp(jax.lax.psum(jnp.log(x), axis))}
+            # gather + prod: exp(psum(log)) NaNs on zero/negative elements
+            return {"Out": jnp.prod(jax.lax.all_gather(x, axis), axis=0)}
         raise ValueError(red)
 
     return compute
@@ -104,6 +111,28 @@ def c_collective_permute(ctx: ExecContext):
     shift = ctx.attr("shift", 1)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return {"Out": jax.lax.ppermute(x, axis, perm)}
+
+
+@register_op("local_sgd_sync", grad="none")
+def local_sgd_sync(ctx: ExecContext):
+    """LocalSGD periodic sync, fused and branchless (reference
+    transpiler/collective.py:269): every `k_steps` steps, allreduce-average the
+    (param - snapshot) deltas and fold them back; other steps pass through.
+    Inputs: Param, Snapshot, Step (int64 scalar, already incremented).
+    Outputs: ParamOut, SnapshotOut."""
+    p = ctx.input("Param")
+    snap = ctx.input("Snapshot")
+    step = ctx.input("Step")
+    k = ctx.attr("k_steps", 1)
+    axis = _axis(ctx)
+    delta = p - snap
+    if axis is not None:
+        delta = jax.lax.psum(delta, axis) / jax.lax.axis_size(axis)
+    synced = snap + delta
+    do_sync = (step % k) == 0
+    new_p = jnp.where(do_sync, synced, p)
+    new_snap = jnp.where(do_sync, synced, snap)
+    return {"ParamOut": new_p, "SnapshotOut": new_snap}
 
 
 @register_op("c_sync_calc_stream", grad="none")

@@ -9,7 +9,7 @@ identity under GSPMD, where the partitioner already reduces).
 """
 from __future__ import annotations
 
-from ..framework import Program, grad_var_name
+from ..framework import Program
 
 __all__ = ["Collective", "GradAllReduce", "LocalSGD"]
 
@@ -56,8 +56,12 @@ def _grad_op_positions(block):
 
 
 class GradAllReduce(Collective):
-    """Insert scale(1/nranks) + c_allreduce_sum on every gradient consumed by
-    an optimizer op (reference transpiler/collective.py:208)."""
+    """Insert mean-allreduce on every gradient consumed by an optimizer op
+    (reference transpiler/collective.py:208 inserts scale(1/nranks) +
+    c_allreduce_sum; here the scale is fused INTO the op via the `avg` attr so
+    it only applies when a real reduction runs — a standalone scale would
+    shrink grads nranks-fold in the GSPMD regime where the allreduce lowers to
+    identity)."""
 
     def _transpile_main(self, program: Program):
         block = program.global_block
@@ -70,10 +74,7 @@ class GradAllReduce(Collective):
         inserts = []
         for _, _, g in targets:
             inserts.append(
-                ("scale", {"X": [g]}, {"Out": [g]}, {"scale": 1.0 / self.nranks})
-            )
-            inserts.append(
-                ("c_allreduce_sum", {"X": [g]}, {"Out": [g]}, {"ring_id": ring})
+                ("c_allreduce_sum", {"X": [g]}, {"Out": [g]}, {"ring_id": ring, "avg": True})
             )
             ring = (ring + 1) % self.nrings
         for j, (t, i_, o, a) in enumerate(inserts):
@@ -92,11 +93,35 @@ class LocalSGD(Collective):
     def _transpile_main(self, program: Program):
         block = program.global_block
         params = [p.name for p in program.all_parameters()]
+        if not params:
+            return
+        # persistable step counter, incremented each run
+        step_name = "@LOCAL_SGD_STEP@"
+        block.create_var(name=step_name, shape=[], dtype="int64",
+                         persistable=True, stop_gradient=True)
+        block.append_op("increment", {"X": [step_name]}, {"Out": [step_name]},
+                        {"step": 1.0})
         for p in params:
-            # param = mean over ranks after local update
+            snap = p + "@SNAPSHOT"
+            pv = block.var(p)
+            block.create_var(name=snap, shape=pv.shape, dtype=pv.dtype,
+                             persistable=True, stop_gradient=True)
             block.append_op(
-                "scale", {"X": [p]}, {"Out": [p]}, {"scale": 1.0 / self.nranks}
+                "local_sgd_sync",
+                {"Param": [p], "Snapshot": [snap], "Step": [step_name]},
+                {"ParamOut": [p], "SnapshotOut": [snap]},
+                {"k_steps": self.k_steps, "ring_id": 0},
             )
-            block.append_op(
-                "c_allreduce_sum", {"X": [p]}, {"Out": [p]}, {"ring_id": 0}
-            )
+
+    def _transpile_startup(self, program: Program):
+        block = program.global_block
+        block.create_var(name="@LOCAL_SGD_STEP@", shape=[], dtype="int64",
+                         persistable=True)
+        block.append_op("fill_constant", {}, {"Out": ["@LOCAL_SGD_STEP@"]},
+                        {"shape": [], "dtype": "int64", "value": 0.0})
+        # snapshot starts equal to the freshly-initialized params
+        for p in program.all_parameters():
+            snap = p.name + "@SNAPSHOT"
+            block.create_var(name=snap, shape=p.shape, dtype=p.dtype,
+                             persistable=True)
+            block.append_op("assign", {"X": [p.name]}, {"Out": [snap]}, {})

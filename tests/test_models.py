@@ -1,0 +1,74 @@
+"""Model-zoo smoke tests: build + train a step, loss decreases for the tiny
+configs (reference book-test pattern, SURVEY.md §4)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import mlp, resnet, transformer
+
+
+def _fresh_programs():
+    main, startup = pt.Program(), pt.Program()
+    return pt.program_guard(main, startup), main, startup
+
+
+def test_bert_tiny_trains():
+    guard, main, startup = _fresh_programs()
+    with guard:
+        cfg = transformer.bert_tiny(use_tp=False)
+        avg_loss, feeds = transformer.bert_pretrain(cfg, seq_len=16)
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        opt.minimize(avg_loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        B, S = 4, 16
+        feed = {
+            "src_ids": rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64),
+            "pos_ids": np.tile(np.arange(S, dtype=np.int64), (B, 1)),
+            "lm_label": rng.integers(0, cfg.vocab_size, (B, S)).astype(np.int64),
+            "lm_weight": np.ones((B, S), np.float32),
+        }
+        losses = []
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[avg_loss])
+            losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet_cifar_forward_backward():
+    guard, main, startup = _fresh_programs()
+    with guard:
+        loss, acc, logits = resnet.resnet_cifar10(num_classes=10)
+        pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.default_rng(1)
+        feed = {
+            "img": rng.standard_normal((8, 3, 32, 32)).astype(np.float32),
+            "label": rng.integers(0, 10, (8, 1)).astype(np.int64),
+        }
+        l0 = exe.run(main, feed=feed, fetch_list=[loss])[0]
+        for _ in range(4):
+            (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert float(l1) < float(l0)
+
+
+def test_mnist_conv_builds():
+    guard, main, startup = _fresh_programs()
+    with guard:
+        avg_loss, acc_v, _ = mlp.mnist_conv()
+        pt.optimizer.SGD(learning_rate=0.1).minimize(avg_loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.default_rng(2)
+        feed = {
+            "img": rng.standard_normal((4, 1, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, (4, 1)).astype(np.int64),
+        }
+        (lv,) = exe.run(main, feed=feed, fetch_list=[avg_loss])
+    assert np.isfinite(lv)
