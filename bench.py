@@ -50,13 +50,9 @@ def main():
         avg_loss, _ = transformer.bert_pretrain(cfg, seq_len=seq_len)
         pt.optimizer.Adam(learning_rate=1e-4).minimize(avg_loss)
 
-    rng = np.random.default_rng(0)
-    feed = {
-        "src_ids": rng.integers(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64),
-        "pos_ids": np.tile(np.arange(seq_len, dtype=np.int64), (batch, 1)),
-        "lm_label": rng.integers(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64),
-        "lm_weight": np.ones((batch, seq_len), np.float32),
-    }
+    from __graft_entry__ import _example_feed
+
+    feed = _example_feed(cfg, batch, seq_len)
 
     exe = pt.Executor()
     with pt.scope_guard(pt.Scope()):

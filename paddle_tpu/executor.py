@@ -305,13 +305,21 @@ class Executor:
 
             ctx = get_comm_context()
             data_axis_name = mesh.axis_names[0]
-            # rings bind to registered axes only when the mesh HAS that axis;
-            # otherwise fall back to the mesh's first (data) axis so psum never
-            # references an unbound axis name
+            # explicitly-registered rings must name a real mesh axis (silent
+            # fallback would reduce over the wrong group); unregistered rings
+            # default to the mesh's first (data) axis
             axis_env = {}
             for ring in range(8):
-                ax = ctx.axis_of(ring)
-                axis_env[ring] = ax if ax in mesh.axis_names else data_axis_name
+                if ring in ctx.registered_rings():
+                    ax = ctx.axis_of(ring)
+                    if ax not in mesh.axis_names:
+                        raise ValueError(
+                            f"collective ring {ring} is registered to mesh axis "
+                            f"'{ax}', which is not in this mesh {mesh.axis_names}"
+                        )
+                    axis_env[ring] = ax
+                else:
+                    axis_env[ring] = data_axis_name
             for ax in mesh.axis_names:
                 axis_env.setdefault(ax, ax)
             fn = _lower(

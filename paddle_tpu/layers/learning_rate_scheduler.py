@@ -1,0 +1,176 @@
+"""Learning-rate schedules as in-program ops over a global step counter.
+
+Reference: /root/reference/python/paddle/fluid/layers/learning_rate_scheduler.py
+(noam_decay:40, exponential_decay:75, natural_exp_decay:114, inverse_time_decay
+:151, polynomial_decay:190, piecewise_decay:243, cosine_decay:295,
+linear_lr_warmup:324). Same contract: call before optimizer construction, pass
+the returned Variable as `learning_rate`. The schedule math is ordinary ops in
+the main program, computed from a persistable step counter incremented once
+per executor run — so it compiles into the same XLA block as the train step.
+"""
+from __future__ import annotations
+
+import math
+
+from ..framework import default_main_program
+from ..initializer import Constant
+from ..layer_helper import LayerHelper
+from . import nn as L
+from . import tensor as T
+
+__all__ = [
+    "noam_decay", "exponential_decay", "natural_exp_decay",
+    "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+    "cosine_decay", "linear_lr_warmup",
+]
+
+_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def _unary(op_type, x):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, {"X": [x]}, {"Out": [out]}, {})
+    return out
+
+
+def _floor(x):
+    return _unary("floor", x)
+
+
+def _ceil(x):
+    return _unary("ceil", x)
+
+
+def _reciprocal(x):
+    return _unary("reciprocal", x)
+
+
+def _cos(x):
+    return _unary("cos", x)
+
+
+def _less_than(x, y):
+    helper = LayerHelper("less_than")
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("less_than", {"X": [x], "Y": [y]}, {"Out": [out]}, {})
+    return out
+
+
+def _decay_step_counter(begin: int = 0):
+    """Auto-incremented float32 step counter (reference
+    layers/tensor.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    program = default_main_program()
+    existed = _COUNTER_NAME in program.global_block.vars
+    if existed:
+        prev_begin = getattr(program.global_block.vars[_COUNTER_NAME],
+                             "_lr_counter_begin", begin)
+        if prev_begin != begin:
+            raise ValueError(
+                f"schedulers with different step-counter origins (begin="
+                f"{prev_begin} vs {begin}) cannot share one program: the "
+                f"shared {_COUNTER_NAME} would be off by one for one of them "
+                f"(noam_decay starts at 1, other schedules at 0)"
+            )
+    # init to begin-1: the in-graph increment runs before first use, so the
+    # first executed step sees `begin` (reference autoincreased_step_counter)
+    counter = helper.create_or_get_global_variable(
+        _COUNTER_NAME, [1], "float32", initializer=Constant(float(begin) - 1.0)
+    )
+    counter._lr_counter_begin = begin
+    if not existed:
+        # one increment per program, however many schedulers share the counter
+        # (composed schedules like linear_lr_warmup(piecewise_decay(...)) must
+        # not double-step)
+        helper.append_op("increment", {"X": [counter]}, {"Out": [counter]}, {"step": 1.0})
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _decay_step_counter(1)
+    a = L.pow(step, -0.5)
+    b = L.scale(step, scale=float(warmup_steps) ** -1.5)
+    return L.scale(L.elementwise_min(a, b), scale=float(d_model) ** -0.5)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    ratio = L.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        ratio = _floor(ratio)
+    return L.scale(L.elementwise_pow(T.fill_constant([1], "float32", float(decay_rate)), ratio),
+                   scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    ratio = L.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        ratio = _floor(ratio)
+    return L.scale(L.exp(L.scale(ratio, scale=-float(decay_rate))),
+                   scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    ratio = L.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        ratio = _floor(ratio)
+    denom = L.scale(ratio, scale=float(decay_rate), bias=1.0)
+    return L.scale(_reciprocal(denom), scale=float(learning_rate))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    step = _decay_step_counter()
+    if cycle:
+        div = _ceil(L.scale(step, scale=1.0 / decay_steps))
+        # at step 0 ceil(0)=0 -> use 1 (reference zero_var/one_var dance)
+        div = L.elementwise_max(div, T.fill_constant([1], "float32", 1.0))
+        decay_var = L.scale(div, scale=float(decay_steps))
+    else:
+        decay_var = T.fill_constant([1], "float32", float(decay_steps))
+        step = L.elementwise_min(step, decay_var)
+    frac = L.elementwise_div(step, decay_var)
+    base = L.pow(L.scale(frac, scale=-1.0, bias=1.0), float(power))
+    return L.scale(base, scale=float(learning_rate) - float(end_learning_rate),
+                   bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    step = _decay_step_counter()
+    helper = LayerHelper("piecewise_decay")
+    lr = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "piecewise_decay", {"Step": [step]}, {"Out": [lr]},
+        {"boundaries": [float(b) for b in boundaries],
+         "values": [float(v) for v in values]},
+    )
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = _floor(L.scale(step, scale=1.0 / step_each_epoch))
+    cosv = _cos(L.scale(epoch, scale=math.pi / epochs))
+    return L.scale(cosv, scale=0.5 * float(learning_rate),
+                   bias=0.5 * float(learning_rate))
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear warmup from start_lr to end_lr over warmup_steps, then the wrapped
+    schedule (float or Variable)."""
+    step = _decay_step_counter()
+    if not isinstance(learning_rate, L.Variable):
+        learning_rate = T.fill_constant([1], "float32", float(learning_rate))
+    frac = L.elementwise_min(L.scale(step, scale=1.0 / warmup_steps),
+                             T.fill_constant([1], "float32", 1.0))
+    warm = L.scale(frac, scale=float(end_lr) - float(start_lr), bias=float(start_lr))
+    in_warmup = L.cast(_less_than(step, T.fill_constant([1], "float32", float(warmup_steps))),
+                       "float32")
+    a = L.elementwise_mul(warm, in_warmup)
+    b = L.elementwise_mul(learning_rate, L.scale(in_warmup, scale=-1.0, bias=1.0))
+    return L.elementwise_add(a, b)

@@ -335,3 +335,15 @@ def fill_constant_batch_size_like(ctx: ExecContext):
     shape = list(ctx.attr("shape"))
     shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
     return {"Out": jnp.full(shape, ctx.attr("value", 0.0), np_dtype(ctx.attr("dtype", "float32")))}
+
+
+@register_op("piecewise_decay", grad="none")
+def piecewise_decay(ctx: ExecContext):
+    """LR piecewise constant schedule, fused (reference
+    learning_rate_scheduler.py:243 builds it from control-flow ops; on TPU a
+    searchsorted gather is one fused XLA op)."""
+    step = ctx.input("Step")
+    bounds = jnp.asarray(ctx.attr("boundaries"), jnp.float32)
+    values = jnp.asarray(ctx.attr("values"), jnp.float32)
+    idx = jnp.searchsorted(bounds, jnp.reshape(step, ()), side="right")
+    return {"Out": jnp.reshape(values[idx], (1,))}

@@ -50,11 +50,17 @@ class CommContext:
     """ring_id -> mesh axis registry (facade mirroring NCCLCommContext)."""
 
     def __init__(self):
-        self._rings: dict[int, str] = {0: DATA_AXIS}
+        # ring 0's DATA_AXIS entry is a *default*, not a user registration —
+        # executors may rebind unregistered rings to the mesh's data axis,
+        # but must error on an explicit registration naming a missing axis
+        self._rings: dict[int, str] = {}
         self.mesh: Mesh | None = None
 
     def register_ring(self, ring_id: int, axis: str):
         self._rings[ring_id] = axis
+
+    def registered_rings(self):
+        return self._rings.keys()
 
     def axis_of(self, ring_id: int) -> str:
         return self._rings.get(ring_id, DATA_AXIS)

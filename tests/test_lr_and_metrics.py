@@ -1,0 +1,153 @@
+"""LR scheduler + metrics tests (reference unittests/
+test_learning_rate_scheduler.py pattern: run N steps, compare the fetched LR
+against the python formula)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def _run_schedule(build_fn, steps=6):
+    """Build `lr = build_fn()` plus a dummy train step; fetch LR per run."""
+    lr = build_fn()
+    x = L.data(name="x", shape=[4], dtype="float32")
+    loss = L.mean(L.fc(x, size=2))
+    opt = pt.optimizer.SGD(learning_rate=lr)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.ones((2, 4), np.float32)
+    out = []
+    for _ in range(steps):
+        (lv,) = exe.run(pt.default_main_program(), feed={"x": xv},
+                        fetch_list=[lr])
+        out.append(float(np.asarray(lv).reshape(-1)[0]))
+    return out
+
+
+def test_exponential_decay():
+    got = _run_schedule(lambda: L.exponential_decay(0.1, decay_steps=2,
+                                                    decay_rate=0.5))
+    want = [0.1 * 0.5 ** (s / 2) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_exponential_decay_staircase():
+    got = _run_schedule(lambda: L.exponential_decay(0.1, 2, 0.5, staircase=True))
+    want = [0.1 * 0.5 ** (s // 2) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    got = _run_schedule(lambda: L.natural_exp_decay(0.1, 2, 0.5))
+    want = [0.1 * math.exp(-0.5 * s / 2) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    got = _run_schedule(lambda: L.inverse_time_decay(0.1, 2, 0.5))
+    want = [0.1 / (1 + 0.5 * s / 2) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    got = _run_schedule(lambda: L.polynomial_decay(0.1, decay_steps=4,
+                                                   end_learning_rate=0.01,
+                                                   power=2.0))
+    want = [(0.1 - 0.01) * (1 - min(s, 4) / 4) ** 2 + 0.01 for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_schedule(lambda: L.piecewise_decay([2, 4], [0.1, 0.05, 0.01]))
+    def ref(s):
+        if s < 2:
+            return 0.1
+        if s < 4:
+            return 0.05
+        return 0.01
+    want = [ref(s) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_noam_decay():
+    got = _run_schedule(lambda: L.noam_decay(64, warmup_steps=4))
+    want = [64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 4 ** -1.5)
+            for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_cosine_decay():
+    got = _run_schedule(lambda: L.cosine_decay(0.1, step_each_epoch=2,
+                                               epochs=3))
+    want = [0.05 * (math.cos(math.pi * (s // 2) / 3) + 1) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linear_lr_warmup():
+    got = _run_schedule(lambda: L.linear_lr_warmup(0.1, warmup_steps=3,
+                                                   start_lr=0.01, end_lr=0.1))
+    def ref(s):
+        return 0.01 + (0.1 - 0.01) * s / 3 if s < 3 else 0.1
+    want = [ref(s) for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_training_with_schedule_converges():
+    lr = L.piecewise_decay([20], [0.1, 0.01])
+    x = L.data(name="x", shape=[8], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+    pt.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((32, 8)).astype(np.float32)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    hist = []
+    for _ in range(30):
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xv, "y": xv @ w}, fetch_list=[loss])
+        hist.append(float(lv))
+    assert hist[-1] < hist[0] * 0.2
+
+
+# --- metrics ---------------------------------------------------------------
+
+def test_accuracy_metric():
+    m = pt.metrics.Accuracy()
+    m.update(0.5, weight=10)
+    m.update(1.0, weight=10)
+    assert abs(m.eval() - 0.75) < 1e-9
+
+
+def test_precision_recall():
+    p, r = pt.metrics.Precision(), pt.metrics.Recall()
+    preds = np.array([1, 1, 0, 0, 1])
+    labels = np.array([1, 0, 1, 0, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.eval() - 2 / 3) < 1e-9
+    assert abs(r.eval() - 2 / 3) < 1e-9
+
+
+def test_auc_perfect_classifier():
+    m = pt.metrics.Auc()
+    probs = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+    labels = np.array([0, 0, 1, 1])
+    m.update(probs, labels)
+    assert m.eval() > 0.99
+
+
+def test_composite_metric():
+    c = pt.metrics.CompositeMetric()
+    c.add_metric(pt.metrics.Precision())
+    c.add_metric(pt.metrics.Recall())
+    preds = np.array([1, 0, 1])
+    labels = np.array([1, 1, 1])
+    c.update(preds, labels)
+    prec, rec = c.eval()
+    assert prec == 1.0 and abs(rec - 2 / 3) < 1e-9

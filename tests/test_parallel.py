@@ -64,6 +64,8 @@ def test_gspmd_dp_matches_single_device():
         )
     )
     np.testing.assert_allclose(single, dp, rtol=1e-4)
+    for name, ref in single_params.items():
+        np.testing.assert_allclose(ref, dp_params[name], rtol=1e-4, atol=1e-5)
 
 
 def test_fleet_collective_matches_single_device():
