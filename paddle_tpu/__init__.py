@@ -18,6 +18,7 @@ from . import parallel  # noqa: F401
 from . import nets  # noqa: F401
 from . import models  # noqa: F401
 from . import metrics  # noqa: F401
+from . import io  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401

@@ -108,6 +108,7 @@ class Variable:
             "stop_gradient": self.stop_gradient,
             "is_data": self.is_data,
             "sharding": list(self.sharding) if self.sharding else None,
+            "is_parameter": isinstance(self, Parameter),
         }
 
     def __repr__(self):
@@ -408,17 +409,18 @@ class Program:
             p.blocks.append(b)
         for bd, b in zip(d["blocks"], p.blocks):
             for name, vd in bd["vars"].items():
-                v = Variable(
-                    b,
+                common = dict(
                     name=vd["name"],
-                    shape=vd["shape"],
-                    dtype=vd["dtype"],
                     kind=VarKind(vd["kind"]),
-                    persistable=vd["persistable"],
                     stop_gradient=vd["stop_gradient"],
                     is_data=vd.get("is_data", False),
                     sharding=tuple(vd["sharding"]) if vd.get("sharding") else None,
                 )
+                if vd.get("is_parameter"):
+                    v = Parameter(b, vd["shape"], vd["dtype"], **common)
+                else:
+                    v = Variable(b, shape=vd["shape"], dtype=vd["dtype"],
+                                 persistable=vd["persistable"], **common)
                 b.vars[name] = v
             for od in bd["ops"]:
                 b.ops.append(Operator(b, od["type"], od["inputs"], od["outputs"], od["attrs"]))

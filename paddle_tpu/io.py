@@ -1,0 +1,222 @@
+"""Checkpoint / serialization — save/load variables and inference models.
+
+Reference: /root/reference/python/paddle/fluid/io.py (save_vars:128,
+save_params:216, save_persistables:487, load_vars:566, load_params:662,
+load_persistables:726, save_inference_model:933, load_inference_model:1113).
+
+Design departure (SURVEY.md §5 checkpoint/resume): the reference executes
+`save`/`load` OPS inside throwaway programs because its executor interprets
+ops one-by-one on the host. Here the executor compiles whole blocks to XLA, so
+file IO stays host-side: variables are read from the Scope (device→host
+gather happens in np.asarray, which also reassembles GSPMD-sharded arrays)
+and written one .npy per variable — the same name-keyed layout the reference
+uses one file per var for. `filename=` packs everything into one .npz
+(save_combine/load_combine equivalent). Programs serialize as JSON via
+Program.to_dict (the framework.proto equivalent).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Sequence
+
+import numpy as np
+
+from .executor import Executor, Scope, global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+]
+
+_MODEL_FILENAME = "__model__.json"
+_SAFE = "%"
+
+
+def _encode_name(name: str) -> str:
+    """Var names may contain '/' etc.; make them filesystem-safe."""
+    return "".join(c if (c.isalnum() or c in "._-@") else f"{_SAFE}{ord(c):02x}"
+                   for c in name)
+
+
+def _is_param(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def _select_vars(program: Program, vars=None, predicate: Callable | None = None):
+    if vars is not None:
+        out = []
+        for v in vars:
+            out.append(program.global_block.var(v) if isinstance(v, str) else v)
+        return out
+    predicate = predicate or _is_persistable
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def save_vars(executor: Executor | None = None, dirname: str = "",
+              main_program: Program | None = None, vars=None,
+              predicate: Callable | None = None, filename: str | None = None,
+              scope: Scope | None = None):
+    """Write selected vars' scope values under `dirname` (io.py:128)."""
+    if not dirname:
+        raise ValueError("save_vars requires a target dirname")
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    selected = _select_vars(program, vars, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in selected:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(
+                f"variable '{v.name}' has no value in scope — run the startup "
+                f"program (and a train step for accumulators) before saving")
+        arrays[v.name] = np.asarray(val)
+    if filename is not None:
+        np.savez(os.path.join(dirname, filename),
+                 **{_encode_name(k): a for k, a in arrays.items()})
+    else:
+        for k, a in arrays.items():
+            np.save(os.path.join(dirname, _encode_name(k) + ".npy"), a)
+    return sorted(arrays)
+
+
+def save_params(executor=None, dirname="", main_program=None, filename=None,
+                scope=None):
+    return save_vars(executor, dirname, main_program, predicate=_is_param,
+                     filename=filename, scope=scope)
+
+
+def save_persistables(executor=None, dirname="", main_program=None,
+                      filename=None, scope=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename, scope=scope)
+
+
+def load_vars(executor: Executor | None = None, dirname: str = "",
+              main_program: Program | None = None, vars=None,
+              predicate: Callable | None = None, filename: str | None = None,
+              scope: Scope | None = None):
+    """Load vars saved by save_vars into the scope (io.py:566)."""
+    if not dirname:
+        raise ValueError("load_vars requires a source dirname")
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    selected = _select_vars(program, vars, predicate)
+    if filename is not None:
+        path = os.path.join(dirname, filename)
+        if not os.path.exists(path) and os.path.exists(path + ".npz"):
+            path = path + ".npz"
+        packed = np.load(path)
+        for v in selected:
+            key = _encode_name(v.name)
+            if key not in packed:
+                raise FileNotFoundError(
+                    f"variable '{v.name}' not found in {path}")
+            scope.set_var(v.name, packed[key])
+    else:
+        for v in selected:
+            path = os.path.join(dirname, _encode_name(v.name) + ".npy")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"no saved file for variable '{v.name}' at {path}")
+            scope.set_var(v.name, np.load(path))
+    return sorted(v.name for v in selected)
+
+
+def load_params(executor=None, dirname="", main_program=None, filename=None,
+                scope=None):
+    return load_vars(executor, dirname, main_program, predicate=_is_param,
+                     filename=filename, scope=scope)
+
+
+def load_persistables(executor=None, dirname="", main_program=None,
+                      filename=None, scope=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename, scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# Inference model export (prune + serialize)
+# ---------------------------------------------------------------------------
+
+
+def _prune_for_targets(program: Program, feed_names: Sequence[str],
+                       target_names: Sequence[str]) -> Program:
+    """Keep only ops on the path feeds -> targets (reference prune.cc via
+    Program._prune, io.py:1005): reverse reachability over the op list,
+    stopping at the feed boundary. Mutates and returns `program` (callers pass
+    a private clone)."""
+    blk = program.global_block
+    feeds = set(feed_names)
+    needed = set(target_names) - feeds
+    keep_flags = [False] * len(blk.ops)
+    for i in range(len(blk.ops) - 1, -1, -1):
+        op = blk.ops[i]
+        # an op is needed iff it produces a needed var; ops that (re)compute a
+        # FED var must go — keeping them would recompute and overwrite the feed
+        if any(n in needed for n in op.output_names):
+            keep_flags[i] = True
+            needed.update(n for n in op.input_names if n and n not in feeds)
+    blk.ops = [op for op, keep in zip(blk.ops, keep_flags) if keep]
+    # drop vars no longer referenced (params kept only if referenced)
+    referenced = set(feed_names) | set(target_names)
+    for op in blk.ops:
+        referenced.update(n for n in op.input_names if n)
+        referenced.update(n for n in op.output_names if n)
+    blk.vars = {k: v for k, v in blk.vars.items() if k in referenced}
+    return program
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars, executor: Executor | None = None,
+                         main_program: Program | None = None,
+                         model_filename: str | None = None,
+                         params_filename: str | None = None,
+                         scope: Scope | None = None):
+    """Prune to the inference subgraph and save program + params (io.py:933)."""
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    target_names = [v.name if isinstance(v, Variable) else str(v)
+                    for v in target_vars]
+    inference = _prune_for_targets(program.clone(for_test=True),
+                                   feeded_var_names, target_names)
+    os.makedirs(dirname, exist_ok=True)
+    desc = inference.to_dict()
+    desc["__meta__"] = {"feed_names": list(feeded_var_names),
+                        "fetch_names": target_names,
+                        "params_filename": params_filename}
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME), "w") as f:
+        json.dump(desc, f)
+    # save every referenced param/persistable the pruned program still needs
+    needed = {v.name for v in inference.list_vars()
+              if _is_param(v) or _is_persistable(v)}
+    save_vars(executor, dirname, program,
+              vars=[n for n in sorted(needed)
+                    if program.global_block.has_var(n)],
+              filename=params_filename, scope=scope)
+    return target_names
+
+
+def load_inference_model(dirname: str, executor: Executor | None = None,
+                         model_filename: str | None = None,
+                         params_filename: str | None = None,
+                         scope: Scope | None = None):
+    """Returns (program, feed_names, fetch_var_names) (io.py:1113)."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME)) as f:
+        desc = json.load(f)
+    meta = desc.pop("__meta__", {})
+    program = Program.from_dict(desc)
+    params_filename = params_filename or meta.get("params_filename")
+    load_vars(executor, dirname, program,
+              vars=[v for v in program.list_vars()
+                    if _is_param(v) or _is_persistable(v)],
+              filename=params_filename, scope=scope)
+    return program, meta.get("feed_names", []), meta.get("fetch_names", [])

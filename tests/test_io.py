@@ -1,0 +1,116 @@
+"""Checkpoint / inference-model round-trip tests (reference book tests'
+save/load round-trip pattern + unittests/test_inference_model_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def _build_and_train(exe, steps=3):
+    x = L.data(name="x", shape=[8], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    h = L.fc(x, size=4, act="relu")
+    pred = L.fc(h, size=1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    eval_prog = pt.default_main_program().clone(for_test=True)
+    pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 8)).astype(np.float32)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    for _ in range(steps):
+        exe.run(pt.default_main_program(), feed={"x": xv, "y": xv @ w},
+                fetch_list=[loss])
+    return pred, loss, xv, eval_prog
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    exe = pt.Executor()
+    pred, loss, xv, eval_prog = _build_and_train(exe)
+    scope = pt.global_scope()
+    main = pt.default_main_program()
+
+    (before,) = exe.run(eval_prog, feed={"x": xv, "y": np.zeros((16, 1), np.float32)}, fetch_list=[pred.name])
+    saved = pt.io.save_persistables(exe, str(tmp_path / "ckpt"))
+    assert any(".w" in n or "fc" in n for n in saved)
+
+    # corrupt every param, then load back and check restoration
+    for name in saved:
+        v = scope.find_var(name)
+        scope.set_var(name, np.zeros_like(np.asarray(v)))
+    pt.io.load_persistables(exe, str(tmp_path / "ckpt"))
+    (after,) = exe.run(eval_prog, feed={"x": xv, "y": np.zeros((16, 1), np.float32)}, fetch_list=[pred.name])
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_save_load_combined_file(tmp_path):
+    exe = pt.Executor()
+    pred, loss, xv, eval_prog = _build_and_train(exe)
+    pt.io.save_params(exe, str(tmp_path / "ckpt"), filename="params.npz")
+    scope = pt.global_scope()
+    names = [p.name for p in pt.default_main_program().all_parameters()]
+    orig = {n: np.asarray(scope.find_var(n)).copy() for n in names}
+    for n in names:
+        scope.set_var(n, np.zeros_like(orig[n]))
+    pt.io.load_params(exe, str(tmp_path / "ckpt"), filename="params.npz")
+    for n in names:
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), orig[n])
+
+
+def test_inference_model_roundtrip(tmp_path):
+    exe = pt.Executor()
+    pred, loss, xv, eval_prog = _build_and_train(exe)
+    main = pt.default_main_program()
+    (want,) = exe.run(eval_prog, feed={"x": xv, "y": np.zeros((16, 1), np.float32)}, fetch_list=[pred.name])
+
+    pt.io.save_inference_model(str(tmp_path / "model"), ["x"], [pred], exe,
+                               main_program=main)
+
+    # load into a FRESH scope: inference must not depend on training state
+    with pt.scope_guard(pt.Scope()):
+        prog, feeds, fetches = pt.io.load_inference_model(
+            str(tmp_path / "model"), exe)
+        assert feeds == ["x"]
+        # pruned program must not contain optimizer/backward ops
+        types = {op.type for op in prog.global_block.ops}
+        assert not any(t.endswith("_grad") or t == "adam" for t in types)
+        (got,) = exe.run(prog, feed={"x": xv}, fetch_list=fetches)
+    np.testing.assert_allclose(want, got, rtol=1e-6)
+
+
+def test_load_missing_var_errors(tmp_path):
+    exe = pt.Executor()
+    _build_and_train(exe)
+    with pytest.raises(FileNotFoundError):
+        pt.io.load_params(exe, str(tmp_path / "nonexistent"))
+
+
+def test_save_before_startup_errors(tmp_path):
+    x = L.data(name="x", shape=[4], dtype="float32")
+    L.fc(x, size=2)
+    exe = pt.Executor()
+    with pytest.raises(RuntimeError, match="startup"):
+        pt.io.save_params(exe, str(tmp_path / "ckpt"))
+
+
+def test_inference_model_mid_graph_feed(tmp_path):
+    """Feeding an intermediate var: pruning must stop at the feed boundary
+    (ops computing the fed var are dropped, not kept)."""
+    exe = pt.Executor()
+    x = L.data(name="x", shape=[8], dtype="float32")
+    h = L.fc(x, size=4, act="relu", name="hlayer")
+    pred = L.fc(h, size=1, name="olayer")
+    exe.run(pt.default_startup_program())
+    pt.io.save_inference_model(str(tmp_path / "m"), [h.name], [pred], exe,
+                               main_program=pt.default_main_program())
+    with pt.scope_guard(pt.Scope()):
+        prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path / "m"), exe)
+        # the op computing h from x must be gone
+        out_names = {n for op in prog.global_block.ops for n in op.output_names}
+        assert h.name not in out_names
+        hv = np.abs(np.random.default_rng(0).standard_normal((3, 4))).astype(np.float32)
+        (got,) = exe.run(prog, feed={h.name: hv}, fetch_list=fetches)
+    assert got.shape == (3, 1)
