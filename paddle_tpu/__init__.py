@@ -19,6 +19,11 @@ from . import nets  # noqa: F401
 from . import models  # noqa: F401
 from . import metrics  # noqa: F401
 from . import io  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .pyreader import DataLoader, PyReader  # noqa: F401
+batch = reader.batch  # paddle.batch alias
 from .backward import append_backward, gradients  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401

@@ -1,0 +1,61 @@
+"""DataFeeder: convert python sample lists into batched numpy feed dicts.
+
+Reference: /root/reference/python/paddle/fluid/data_feeder.py (DataFeeder:48,
+DataToLoDTensorConverter:27). The reference builds LoDTensors for ragged
+sequences; XLA needs static shapes, so ragged fields are padded to the batch
+max (plus an optional companion '<name>_len' length vector replacing LoD —
+SURVEY.md §5 long-context notes)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import Variable
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None, pad_ragged=True,
+                 emit_lengths=False):
+        self.feed_vars: list[Variable] = list(feed_list)
+        self.place = place
+        self.pad_ragged = pad_ragged
+        self.emit_lengths = emit_lengths
+
+    def feed(self, iterable) -> dict:
+        """iterable: list of samples; each sample is a tuple/list with one
+        entry per feed var. Returns {var_name: batched ndarray}."""
+        samples = list(iterable)
+        if not samples:
+            raise ValueError("DataFeeder.feed got an empty batch")
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            cols = [np.asarray(s[i]) for s in samples]
+            dtype = var.np_dtype
+            shapes = {c.shape for c in cols}
+            if len(shapes) == 1:
+                arr = np.stack(cols).astype(dtype, copy=False)
+            elif self.pad_ragged:
+                arr = _pad_stack(cols, dtype)
+                if self.emit_lengths:
+                    out[var.name + "_len"] = np.asarray(
+                        [c.shape[0] for c in cols], np.int64)
+            else:
+                raise ValueError(
+                    f"ragged samples for '{var.name}' and pad_ragged=False")
+            # vars declared with trailing dim 1 (labels [1]) accept scalars
+            want_rank = len(var.shape)
+            if arr.ndim == want_rank - 1:
+                arr = arr[..., None]
+            out[var.name] = arr
+        return out
+
+
+def _pad_stack(cols, dtype):
+    rank = cols[0].ndim
+    maxes = [max(c.shape[d] for c in cols) for d in range(rank)]
+    out = np.zeros([len(cols)] + maxes, dtype)
+    for i, c in enumerate(cols):
+        sl = tuple(slice(0, s) for s in c.shape)
+        out[(i,) + sl] = c
+    return out

@@ -1,0 +1,18 @@
+"""Built-in dataset loaders (reference python/paddle/dataset/: mnist.py,
+cifar.py, uci_housing.py, imdb.py, wmt14/16.py, movielens.py, flowers.py).
+
+Each module exposes `train()` / `test()` reader creators yielding the same
+sample tuples as the reference. Loaders read the standard archive formats
+from DATA_HOME (`PADDLE_TPU_DATA_HOME`, default ~/.cache/paddle_tpu/dataset)
+when present; this build has zero network egress, so when the files are
+absent the loaders yield a deterministic synthetic dataset with identical
+shapes/dtypes/ranges (flagged via `is_synthetic()`), keeping every
+train/eval pipeline runnable end to end.
+"""
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import wmt16  # noqa: F401
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "wmt16"]

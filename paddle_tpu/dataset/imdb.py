@@ -1,0 +1,80 @@
+"""IMDB sentiment (reference python/paddle/dataset/imdb.py): samples are
+(token_ids: int64 list, label: int64 0/1). word_dict() gives the vocab."""
+from __future__ import annotations
+
+import re
+import tarfile
+
+import numpy as np
+
+from .common import locate
+
+__all__ = ["train", "test", "word_dict", "is_synthetic"]
+
+_VOCAB = 5147  # synthetic vocab size (reference build_dict cutoff ~5147)
+_SYN_TRAIN, _SYN_TEST = 2048, 256
+_TOKEN = re.compile(r"[a-z]+")
+
+
+def is_synthetic() -> bool:
+    return locate("imdb", "aclImdb_v1.tar.gz") is None
+
+
+def word_dict() -> dict:
+    path = locate("imdb", "aclImdb_v1.tar.gz")
+    if path:
+        freq: dict = {}
+        with tarfile.open(path, "r:gz") as tf:
+            for m in tf.getmembers():
+                if re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name):
+                    text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
+                    for w in _TOKEN.findall(text):
+                        freq[w] = freq.get(w, 0) + 1
+        words = sorted(freq, key=lambda w: (-freq[w], w))
+        d = {w: i for i, w in enumerate(words)}
+    else:
+        d = {f"w{i}": i for i in range(_VOCAB - 1)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _parse(path, split, wd):
+    unk = wd["<unk>"]
+    with tarfile.open(path, "r:gz") as tf:
+        for m in tf.getmembers():
+            mm = re.match(rf"aclImdb/{split}/(pos|neg)/.*\.txt$", m.name)
+            if mm:
+                text = tf.extractfile(m).read().decode("utf-8", "ignore").lower()
+                ids = [wd.get(w, unk) for w in _TOKEN.findall(text)]
+                yield ids, int(mm.group(1) == "pos")
+
+
+def _synthetic(n, seed):
+    rng = np.random.default_rng(seed)
+    # class-dependent token distributions so the task is learnable
+    for _ in range(n):
+        label = int(rng.integers(0, 2))
+        length = int(rng.integers(16, 128))
+        lo, hi = (0, _VOCAB // 2) if label == 0 else (_VOCAB // 2, _VOCAB)
+        ids = rng.integers(lo, hi, length).tolist()
+        yield ids, label
+
+
+def _reader(split, seed):
+    def reader():
+        path = locate("imdb", "aclImdb_v1.tar.gz")
+        if path:
+            yield from _parse(path, split, word_dict())
+        else:
+            yield from _synthetic(_SYN_TRAIN if split == "train" else _SYN_TEST,
+                                  seed)
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train", 0)
+
+
+def test(word_idx=None):
+    return _reader("test", 1)

@@ -1,0 +1,86 @@
+"""PyReader / DataLoader: background-thread prefetch feeding the executor.
+
+Reference: /root/reference/python/paddle/fluid/reader.py (PyReader:47) +
+operators/reader/buffered_reader.cc (host->device double buffering) +
+lod_tensor_blocking_queue.h. TPU re-design: one python background thread
+fills a bounded queue with ready feed dicts (the LoDTensorBlockingQueue
+equivalent); device transfer overlaps compute because jit dispatch is async —
+XLA owns the actual double buffering. `iterable=True` mode only (the
+start/reset in-program reader-op protocol has no XLA analogue; the reference
+itself deprecated it)."""
+from __future__ import annotations
+
+from .data_feeder import DataFeeder
+from .reader import _prefetch_iter
+
+__all__ = ["PyReader", "DataLoader"]
+
+
+class PyReader:
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        if not iterable:
+            raise NotImplementedError(
+                "non-iterable PyReader (start/reset protocol) is not part of "
+                "the TPU build; iterate the reader object instead")
+        self.feed_list = feed_list
+        self.capacity = capacity
+        self.return_list = return_list
+        self._feeder = DataFeeder(feed_list) if feed_list else None
+        self._source = None  # callable -> generator of feed dicts
+
+    # -- decoration (reference reader.py:214-372) ---------------------------
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        from . import reader as _reader
+
+        self.decorate_sample_list_generator(
+            _reader.batch(sample_generator, batch_size, drop_last), places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        """reader: generator of SAMPLE LISTS (paddle.batch output)."""
+        if self._feeder is None:
+            raise ValueError("feed_list is required for sample-list mode")
+
+        def gen():
+            for samples in reader():
+                yield self._feeder.feed(samples)
+
+        self._source = gen
+
+    def decorate_batch_generator(self, reader, places=None):
+        """reader: generator of ready feed dicts (or tuples matching
+        feed_list order)."""
+
+        def gen():
+            for item in reader():
+                if isinstance(item, dict):
+                    yield item
+                else:
+                    yield {v.name: a for v, a in zip(self.feed_list, item)}
+
+        self._source = gen
+
+    # -- iteration ----------------------------------------------------------
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._source is None:
+            raise RuntimeError("decorate_* must be called before iterating")
+        for d in _prefetch_iter(self._source, self.capacity):
+            if self.return_list:
+                yield [d[v.name] for v in self.feed_list]
+            else:
+                yield d
+
+
+class DataLoader:
+    """fluid.io.DataLoader facade (2.x-style entry the reference was growing
+    toward); from_generator mirrors PyReader."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return PyReader(feed_list, capacity, use_double_buffer, iterable,
+                        return_list)

@@ -1,0 +1,138 @@
+"""Data pipeline tests: reader decorators, DataFeeder padding, PyReader
+prefetch, dataset loaders, end-to-end training from a PyReader (reference
+unittests/test_pyreader*, reader decorator tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu import reader as R
+from paddle_tpu.dataset import imdb, mnist, uci_housing
+
+
+def test_batch_and_firstn():
+    r = R.batch(lambda: iter(range(10)), 3)
+    batches = list(r())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    r2 = R.batch(lambda: iter(range(10)), 3, drop_last=True)
+    assert list(r2())[-1] == [6, 7, 8]
+    assert list(R.firstn(lambda: iter(range(10)), 4)()) == [0, 1, 2, 3]
+
+
+def test_shuffle_preserves_multiset():
+    r = R.shuffle(lambda: iter(range(100)), buf_size=16)
+    assert sorted(r()) == list(range(100))
+
+
+def test_chain_compose_map():
+    a = lambda: iter([1, 2])
+    b = lambda: iter([3, 4])
+    assert list(R.chain(a, b)()) == [1, 2, 3, 4]
+    assert list(R.compose(a, b)()) == [(1, 3), (2, 4)]
+    assert list(R.map_readers(lambda x, y: x + y, a, b)()) == [4, 6]
+
+
+def test_compose_misaligned_raises():
+    a = lambda: iter([1, 2, 3])
+    b = lambda: iter([4])
+    with pytest.raises(RuntimeError):
+        list(R.compose(a, b)())
+
+
+def test_buffered_and_xmap():
+    r = R.buffered(lambda: iter(range(50)), size=8)
+    assert list(r()) == list(range(50))
+    xm = R.xmap_readers(lambda x: x * 2, lambda: iter(range(20)),
+                        process_num=4, buffer_size=8, order=True)
+    assert list(xm()) == [2 * i for i in range(20)]
+    xm2 = R.xmap_readers(lambda x: x * 2, lambda: iter(range(20)),
+                         process_num=4, buffer_size=8, order=False)
+    assert sorted(xm2()) == [2 * i for i in range(20)]
+
+
+def test_data_feeder_pads_ragged():
+    x = L.data(name="ids", shape=[-1], dtype="int64")
+    y = L.data(name="lab", shape=[1], dtype="int64")
+    feeder = pt.DataFeeder([x, y], emit_lengths=True)
+    feed = feeder.feed([([1, 2, 3], 0), ([4], 1)])
+    np.testing.assert_array_equal(feed["ids"], [[1, 2, 3], [4, 0, 0]])
+    np.testing.assert_array_equal(feed["ids_len"], [3, 1])
+    assert feed["lab"].shape == (2, 1)
+
+
+def test_dataset_loaders_shapes():
+    img, lab = next(mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert isinstance(lab, int)
+    feats, price = next(uci_housing.train()())
+    assert feats.shape == (13,) and price.shape == (1,)
+    ids, sentiment = next(imdb.train()())
+    assert isinstance(ids, list) and sentiment in (0, 1)
+
+
+def test_pyreader_end_to_end_training():
+    img = L.data(name="img", shape=[784], dtype="float32")
+    label = L.data(name="label", shape=[1], dtype="int64")
+    loss = L.mean(L.softmax_with_cross_entropy(L.fc(img, size=10), label))
+    pt.optimizer.SGD(0.1).minimize(loss)
+
+    loader = pt.PyReader(feed_list=[img, label], capacity=4)
+    loader.decorate_sample_list_generator(
+        R.batch(mnist.train(), batch_size=64, drop_last=True))
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    losses = []
+    for i, feed in enumerate(loader()):
+        (lv,) = exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+        if i >= 20:
+            break
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_pyreader_propagates_worker_errors():
+    img = L.data(name="im2", shape=[4], dtype="float32")
+    loader = pt.PyReader(feed_list=[img], capacity=2)
+
+    def bad_reader():
+        yield [(np.zeros(4, np.float32),)]
+        raise ValueError("boom")
+
+    loader.decorate_sample_list_generator(lambda: bad_reader())
+    with pytest.raises(ValueError, match="boom"):
+        for _ in loader():
+            pass
+
+
+def test_xmap_mapper_error_propagates_no_deadlock():
+    xm = R.xmap_readers(lambda x: 1 // x, lambda: iter([1, 0, 2]),
+                        process_num=2, buffer_size=4)
+    with pytest.raises(ZeroDivisionError):
+        list(xm())
+
+
+def test_buffered_propagates_errors():
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        list(R.buffered(lambda: bad(), 4)())
+
+
+def test_cache_partial_first_pass_not_poisoned():
+    c = R.cache(lambda: iter(range(5)))
+    it = c()
+    next(it)  # peek one sample, abandon
+    del it
+    assert list(c()) == [0, 1, 2, 3, 4]
+    assert list(c()) == [0, 1, 2, 3, 4]
+
+
+def test_wmt16_tuple_order():
+    from paddle_tpu.dataset import wmt16
+    src, trg_in, trg_next = next(wmt16.train()())
+    assert trg_in[0] == wmt16.BOS
+    assert trg_next[-1] == wmt16.EOS
+    assert trg_in[1:] == trg_next[:-1]
