@@ -19,6 +19,7 @@ from . import nets  # noqa: F401
 from . import models  # noqa: F401
 from . import metrics  # noqa: F401
 from . import io  # noqa: F401
+from . import contrib  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

@@ -1,0 +1,68 @@
+"""Op lists steering automatic mixed precision.
+
+Reference: /root/reference/python/paddle/fluid/contrib/mixed_precision/
+fp16_lists.py (AutoMixedPrecisionLists:19, white_list:60, black_list:67,
+gray_list:77). The split is the same idea retuned for TPU: white ops are the
+MXU FLOP carriers (matmul/conv) that should run in bfloat16; black ops are
+numerically-sensitive reductions/exponentials kept in float32; everything
+else (gray) follows its inputs — our JAX kernels are dtype-polymorphic, so
+gray needs no rewriting at all."""
+from __future__ import annotations
+
+__all__ = ["AutoMixedPrecisionLists", "white_list", "black_list", "gray_list"]
+
+white_list = {
+    "mul",
+    "matmul",
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+}
+
+black_list = {
+    "exp",
+    "square",
+    "log",
+    "mean",
+    "sum",
+    "cos_sim",
+    "softmax",
+    "log_softmax",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "cross_entropy",
+    "layer_norm",
+    "batch_norm",
+    "reduce_sum",
+    "reduce_mean",
+    "squared_l2_norm",
+}
+
+gray_list = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "relu", "gelu", "tanh", "sigmoid", "leaky_relu", "dropout", "pool2d",
+    "transpose2", "reshape2", "concat", "split", "slice", "squeeze2",
+    "unsqueeze2", "stack", "scale", "lookup_table", "lookup_table_v2",
+}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        if custom_white_list and custom_black_list:
+            both = set(custom_white_list) & set(custom_black_list)
+            if both:
+                raise ValueError(f"ops in both custom lists: {both}")
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        if custom_white_list:
+            for t in custom_white_list:
+                self.white_list.add(t)
+                self.black_list.discard(t)
+        if custom_black_list:
+            for t in custom_black_list:
+                self.black_list.add(t)
+                self.white_list.discard(t)
+        overlap = self.white_list & self.black_list
+        if overlap:
+            raise ValueError(f"ops in both white and black lists: {overlap}")

@@ -1,0 +1,94 @@
+"""Program rewriting for mixed precision: insert casts around white/black ops.
+
+Reference: /root/reference/python/paddle/fluid/contrib/mixed_precision/
+fp16_utils.py (rewrite_program:139, _insert_cast_op:60). Same transformation,
+bfloat16-first: white ops get their float32 inputs cast to the low dtype
+(cast vars are reused per (name, dtype)), black ops get low-dtype inputs cast
+back to float32. Parameters stay float32 in the scope — the in-program cast
+IS the master-weight scheme: the optimizer updates fp32 params, the forward
+consumes their low-precision view, and XLA fuses the cast into the consumer.
+"""
+from __future__ import annotations
+
+from ...core.types import DType
+from ...framework import Operator, Program
+
+__all__ = ["rewrite_program", "cast_var_suffix"]
+
+_LOW = {"bfloat16": "@BF16", "float16": "@FP16"}
+
+
+def cast_var_suffix(dest_dtype: str) -> str:
+    return _LOW.get(dest_dtype, "@LOW")
+
+
+def _cast_input(block, op_idx, name, dest_dtype, cache):
+    """Insert (or reuse) `cast(name) -> name@SUFFIX` before op_idx; returns
+    the cast var name and how many ops were inserted (0 or 1)."""
+    try:
+        src = block.var(name)
+    except KeyError:
+        return name, 0
+    if dest_dtype == "float32":
+        if src.dtype not in (DType.BF16, DType.FP16):
+            return name, 0
+    elif src.dtype != DType.FP32:
+        return name, 0  # only fp32 tensors get a low-precision view
+    key = (name, dest_dtype)
+    if key in cache:
+        return cache[key], 0
+    suffix = "@FP32" if dest_dtype == "float32" else cast_var_suffix(dest_dtype)
+    cast_name = name + suffix
+    if not block.has_var(cast_name):
+        block.create_var(name=cast_name, shape=src.shape, dtype=dest_dtype,
+                         stop_gradient=src.stop_gradient)
+    block._insert_op(
+        op_idx, "cast", {"X": [name]}, {"Out": [cast_name]},
+        {"in_dtype": src.dtype.value, "out_dtype": dest_dtype},
+    )
+    cache[key] = cast_name
+    return cast_name, 1
+
+
+def rewrite_program(main_program: Program, amp_lists, dest_dtype="bfloat16"):
+    """Walk the (forward) op list, casting white-op inputs to `dest_dtype` and
+    black-op inputs back to float32. Returns the number of casts inserted.
+    Must run BEFORE append_backward so grad ops derive through the casts."""
+    block = main_program.global_block
+    cache: dict = {}
+    i = 0
+    n_casts = 0
+    from ...ops.registry import infer_op
+
+    while i < len(block.ops):
+        op = block.ops[i]
+        target = None
+        if op.type in amp_lists.white_list:
+            target = dest_dtype
+        elif op.type in amp_lists.black_list:
+            target = "float32"
+        if target is None:
+            # gray op: no casts, but RE-INFER its output dtype so bf16-ness
+            # propagates through metadata — otherwise a black op downstream
+            # of white->gray sees stale fp32 metadata and never casts back
+            infer_op(op, block)
+            i += 1
+            continue
+        inserted_here = 0
+        for slot, names in list(op.inputs.items()):
+            new_names = []
+            for name in names:
+                if not name:
+                    new_names.append(name)
+                    continue
+                new_name, inserted = _cast_input(block, i, name, target, cache)
+                new_names.append(new_name)
+                inserted_here += inserted
+                i += inserted
+            op.inputs[slot] = new_names
+        # re-infer this op's output dtype under the new input dtypes
+        infer_op(op, block)
+        n_casts += inserted_here
+        i += 1
+    main_program._bump_version()
+    return n_casts

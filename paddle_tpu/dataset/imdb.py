@@ -20,8 +20,14 @@ def is_synthetic() -> bool:
     return locate("imdb", "aclImdb_v1.tar.gz") is None
 
 
+_word_dict_cache: dict = {}
+
+
 def word_dict() -> dict:
     path = locate("imdb", "aclImdb_v1.tar.gz")
+    key = path or "<synthetic>"
+    if key in _word_dict_cache:
+        return _word_dict_cache[key]
     if path:
         freq: dict = {}
         with tarfile.open(path, "r:gz") as tf:
@@ -35,6 +41,7 @@ def word_dict() -> dict:
     else:
         d = {f"w{i}": i for i in range(_VOCAB - 1)}
     d["<unk>"] = len(d)
+    _word_dict_cache[key] = d
     return d
 
 
@@ -49,32 +56,36 @@ def _parse(path, split, wd):
                 yield ids, int(mm.group(1) == "pos")
 
 
-def _synthetic(n, seed):
+def _synthetic(n, seed, vocab=_VOCAB):
     rng = np.random.default_rng(seed)
     # class-dependent token distributions so the task is learnable
     for _ in range(n):
         label = int(rng.integers(0, 2))
         length = int(rng.integers(16, 128))
-        lo, hi = (0, _VOCAB // 2) if label == 0 else (_VOCAB // 2, _VOCAB)
+        lo, hi = (0, vocab // 2) if label == 0 else (vocab // 2, vocab)
         ids = rng.integers(lo, hi, length).tolist()
         yield ids, label
 
 
-def _reader(split, seed):
+def _reader(split, seed, word_idx=None):
     def reader():
         path = locate("imdb", "aclImdb_v1.tar.gz")
         if path:
-            yield from _parse(path, split, word_dict())
+            yield from _parse(path, split, word_idx or word_dict())
         else:
+            vocab = (max(word_idx.values()) + 1) if word_idx else _VOCAB
             yield from _synthetic(_SYN_TRAIN if split == "train" else _SYN_TEST,
-                                  seed)
+                                  seed, vocab)
 
     return reader
 
 
 def train(word_idx=None):
-    return _reader("train", 0)
+    """word_idx: optional custom vocabulary dict {word: id} (reference
+    imdb.py train(word_idx)); ids are emitted from it so they never exceed
+    the caller's embedding table."""
+    return _reader("train", 0, word_idx)
 
 
 def test(word_idx=None):
-    return _reader("test", 1)
+    return _reader("test", 1, word_idx)

@@ -54,6 +54,11 @@ def _bcast_y(x, y, axis: int):
     x[axis : axis+y.ndim], padding trailing 1s."""
     if x.shape == y.shape:
         return y
+    if y.ndim > x.ndim:
+        raise ValueError(
+            f"elementwise op: Y rank {y.ndim} exceeds X rank {x.ndim} "
+            f"(shapes {y.shape} vs {x.shape}) — the reference broadcast rule "
+            f"requires rank(Y) <= rank(X)")
     if axis == -1 or axis is None:
         axis = x.ndim - y.ndim
     new_shape = [1] * x.ndim

@@ -244,3 +244,49 @@ def lars_momentum(ctx: ExecContext):
         "ParamOut": (p - v_new).astype(ctx.input("Param").dtype),
         "VelocityOut": v_new,
     }
+
+
+@register_op("check_finite_and_unscale", grad="none")
+def check_finite_and_unscale(ctx: ExecContext):
+    """AMP grad check: divide grads by Scale; FoundInfinite=1 if ANY grad has
+    a nan/inf, in which case outputs are zeroed so the optimizer step is a
+    (moment-polluting but parameter-safe) no-op — branchless XLA version of
+    the reference's conditional skip (contrib/mixed_precision/decorator.py)."""
+    xs = ctx.inputs("X")
+    scale = ctx.input("Scale")
+    inv = 1.0 / jnp.reshape(scale, ())
+    found = jnp.zeros((), jnp.bool_)
+    for x in xs:
+        found = found | ~jnp.all(jnp.isfinite(x))
+    outs = [jnp.where(found, jnp.zeros_like(x), x * inv.astype(x.dtype)) for x in xs]
+    return {"Out": outs, "FoundInfinite": jnp.reshape(found, (1,))}
+
+
+@register_op("update_loss_scaling", grad="none")
+def update_loss_scaling(ctx: ExecContext):
+    """Dynamic loss-scale state machine (reference update op semantics):
+    after `incr_every_n_steps` consecutive finite steps multiply the scale by
+    incr_ratio; after `decr_every_n_nan_or_inf` bad steps multiply by
+    decr_ratio (floored at 1.0). Branchless jnp.where version."""
+    scale = jnp.reshape(ctx.input("PrevLossScaling"), ())
+    good = jnp.reshape(ctx.input("InGoodSteps"), ()).astype(jnp.int32)
+    bad = jnp.reshape(ctx.input("InBadSteps"), ()).astype(jnp.int32)
+    found = jnp.reshape(ctx.input("FoundInfinite"), ()).astype(jnp.bool_)
+    incr_n = ctx.attr("incr_every_n_steps", 1000)
+    decr_n = ctx.attr("decr_every_n_nan_or_inf", 2)
+    incr_ratio = ctx.attr("incr_ratio", 2.0)
+    decr_ratio = ctx.attr("decr_ratio", 0.5)
+
+    good_next = jnp.where(found, 0, good + 1)
+    bad_next = jnp.where(found, bad + 1, 0)
+    do_incr = (~found) & (good_next >= incr_n)
+    do_decr = found & (bad_next >= decr_n)
+    new_scale = jnp.where(do_incr, scale * incr_ratio, scale)
+    new_scale = jnp.where(do_decr, jnp.maximum(scale * decr_ratio, 1.0), new_scale)
+    good_next = jnp.where(do_incr, 0, good_next)
+    bad_next = jnp.where(do_decr, 0, bad_next)
+    return {
+        "LossScaling": jnp.reshape(new_scale, ()),
+        "OutGoodSteps": jnp.reshape(good_next, (1,)).astype(jnp.int32),
+        "OutBadSteps": jnp.reshape(bad_next, (1,)).astype(jnp.int32),
+    }

@@ -197,62 +197,82 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
         errors: list = []
+        stop = threading.Event()
+
+        def _put(q, item) -> bool:
+            """Bounded put that gives up when the consumer abandoned us."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def read_into():
             try:
                 for i, sample in enumerate(reader()):
-                    in_q.put((i, sample))
+                    if not _put(in_q, (i, sample)):
+                        return
             except BaseException as e:
                 errors.append(e)
             finally:
                 # always deliver every worker its end marker, even after an
                 # error — a missing sentinel deadlocks the whole pipeline
                 for _ in range(process_num):
-                    in_q.put(end)
+                    if not _put(in_q, end):
+                        return
 
         def work():
             try:
-                while True:
-                    item = in_q.get()
+                while not stop.is_set():
+                    try:
+                        item = in_q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
                     if item is end:
                         return
                     i, sample = item
-                    out_q.put((i, mapper(sample)))
+                    if not _put(out_q, (i, mapper(sample))):
+                        return
             except BaseException as e:
                 errors.append(e)
             finally:
-                out_q.put(end)
+                _put(out_q, end)
 
         threading.Thread(target=read_into, daemon=True).start()
         workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
         for w in workers:
             w.start()
 
-        finished = 0
-        if order:
-            pending: dict = {}
-            next_i = 0
-            while finished < process_num:
-                item = out_q.get()
-                if item is end:
-                    finished += 1
-                    continue
-                i, mapped = item
-                pending[i] = mapped
-                while next_i in pending:
-                    yield pending.pop(next_i)
-                    next_i += 1
-            for i in sorted(pending):
-                yield pending[i]
-        else:
-            while finished < process_num:
-                item = out_q.get()
-                if item is end:
-                    finished += 1
-                    continue
-                yield item[1]
-        if errors:
-            raise errors[0]
+        try:
+            finished = 0
+            if order:
+                pending: dict = {}
+                next_i = 0
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    i, mapped = item
+                    pending[i] = mapped
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
+                for i in sorted(pending):
+                    yield pending[i]
+            else:
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    yield item[1]
+            if errors:
+                raise errors[0]
+        finally:
+            stop.set()
 
     return xreader
 

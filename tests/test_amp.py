@@ -1,0 +1,112 @@
+"""AMP tests: program rewriting, bf16 training parity, dynamic loss scaling
+state machine (reference unittests/test_image_classification_fp16.py idea +
+update_loss_scaling op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.contrib import mixed_precision as amp
+
+
+def _build(seed=3):
+    x = L.data(name="x", shape=[16], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="int64")
+    h = L.fc(x, size=32, act="relu")
+    logits = L.fc(h, size=4)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, y))
+    return loss
+
+
+def _batch(rng, bs=64):
+    x = rng.standard_normal((bs, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 4)).astype(np.float32)
+    y = np.argmax(x @ w, 1).astype(np.int64)[:, None]
+    return x, y
+
+
+def test_rewrite_inserts_bf16_casts():
+    loss = _build()
+    main = pt.default_main_program()
+    n = amp.rewrite_program(main, amp.AutoMixedPrecisionLists(), "bfloat16")
+    assert n > 0
+    types = [op.type for op in main.global_block.ops]
+    assert "cast" in types
+    # mul (fc matmul) inputs must now be the bf16 views
+    mul_ops = [op for op in main.global_block.ops if op.type == "mul"]
+    assert all(any(n.endswith("@BF16") for n in op.input_names)
+               for op in mul_ops)
+
+
+def test_bf16_training_tracks_fp32():
+    rng = np.random.default_rng(0)
+    x, y = _batch(rng)
+
+    def train(use_amp):
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 5
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                loss = _build()
+                opt = pt.optimizer.Momentum(0.05, 0.9)
+                if use_amp:
+                    opt = amp.decorate(opt)
+                opt.minimize(loss)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            hist = []
+            for _ in range(15):
+                (lv,) = exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss.name])
+                hist.append(float(np.asarray(lv).reshape(-1)[0]))
+        return hist
+
+    fp32 = train(False)
+    bf16 = train(True)
+    assert bf16[-1] < bf16[0] * 0.7
+    # bf16 should track fp32 loosely (same trajectory, lower precision)
+    assert abs(bf16[-1] - fp32[-1]) < 0.35, (fp32[-1], bf16[-1])
+
+
+def test_dynamic_loss_scaling_recovers_from_overflow():
+    """Feed an input that overflows fp16-style scaled grads: scale must drop
+    and params must survive (no nans)."""
+    x = L.data(name="x", shape=[8], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+    opt = amp.decorate(pt.optimizer.SGD(0.01), init_loss_scaling=2.0 ** 15,
+                       use_dynamic_loss_scaling=True,
+                       decr_every_n_nan_or_inf=1)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    rng = np.random.default_rng(1)
+
+    # normal step
+    xv = rng.standard_normal((8, 8)).astype(np.float32)
+    yv = np.ones((8, 1), np.float32)
+    exe.run(pt.default_main_program(), feed={"x": xv, "y": yv},
+            fetch_list=[loss])
+    s1 = float(np.asarray(scope.find_var("@LOSS_SCALING@")).reshape(-1)[0])
+
+    # overflow step: gigantic input -> inf grads after scaling
+    exe.run(pt.default_main_program(),
+            feed={"x": np.full((8, 8), 1e30, np.float32), "y": yv},
+            fetch_list=[loss])
+    s2 = float(np.asarray(scope.find_var("@LOSS_SCALING@")).reshape(-1)[0])
+    assert s2 < s1  # scale halved
+
+    # params stayed finite and training continues
+    (lv,) = exe.run(pt.default_main_program(), feed={"x": xv, "y": yv},
+                    fetch_list=[loss])
+    assert np.isfinite(float(lv))
+
+
+def test_custom_lists_override():
+    lists = amp.AutoMixedPrecisionLists(custom_black_list={"mul"})
+    assert "mul" not in lists.white_list
+    with pytest.raises(ValueError):
+        amp.AutoMixedPrecisionLists(custom_white_list={"softmax"},
+                                    custom_black_list={"softmax"})

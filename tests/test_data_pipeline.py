@@ -136,3 +136,23 @@ def test_wmt16_tuple_order():
     assert trg_in[0] == wmt16.BOS
     assert trg_next[-1] == wmt16.EOS
     assert trg_in[1:] == trg_next[:-1]
+
+
+def test_xmap_abandoned_iteration_stops_workers():
+    import threading
+    base = threading.active_count()
+    xm = R.xmap_readers(lambda x: x, lambda: iter(range(1000)),
+                        process_num=3, buffer_size=2)
+    it = xm()
+    next(it)
+    it.close()  # abandon
+    import time
+    time.sleep(0.5)
+    assert threading.active_count() <= base + 1  # threads wound down
+
+
+def test_imdb_honors_custom_word_idx():
+    from paddle_tpu.dataset import imdb
+    wd = {f"w{i}": i for i in range(100)}
+    ids, label = next(imdb.train(word_idx=wd)())
+    assert max(ids) < 100
