@@ -70,10 +70,21 @@ def append_backward(
     ops_snapshot = [block.ops[i] for i in op_path]
     for op in reversed(ops_snapshot):
         opdef = get_op_def(op.type) if _has(op.type) else None
-        if opdef is None or opdef.no_grad:
-            continue
         if not any(grad_var_name(n) in available_grads or n == loss.name for n in op.output_names):
             # no grad flows into this op's outputs
+            continue
+        if opdef is None or opdef.no_grad:
+            # forward-only op ON the gradient path: silently skipping would
+            # freeze every upstream parameter with no diagnostic. Raise unless
+            # the op has no differentiable inputs (pure sources like
+            # fill_constant are harmless).
+            if _has_differentiable_inputs(op, block, no_grad):
+                raise RuntimeError(
+                    f"op '{op.type}' lies on the gradient path to '{loss.name}'"
+                    f" but has no gradient (forward-only). Parameters upstream "
+                    f"of it would silently stop training. Use a differentiable "
+                    f"alternative (e.g. static_rnn instead of while), or mark "
+                    f"its inputs stop_gradient=True if this is intended.")
             continue
         maker = opdef.grad_maker or default_grad_maker
         specs = maker(op, block, frozenset(no_grad))
@@ -134,6 +145,21 @@ def _has(t):
         return True
     except KeyError:
         return False
+
+
+def _has_differentiable_inputs(op, block, no_grad: set) -> bool:
+    from .core.types import is_floating
+
+    for n in op.input_names:
+        if not n or n in no_grad:
+            continue
+        try:
+            v = block.var(n)
+        except KeyError:
+            continue
+        if is_floating(v.dtype) and not v.stop_gradient:
+            return True
+    return False
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

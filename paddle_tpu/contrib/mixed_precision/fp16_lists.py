@@ -63,6 +63,3 @@ class AutoMixedPrecisionLists:
             for t in custom_black_list:
                 self.black_list.add(t)
                 self.white_list.discard(t)
-        overlap = self.white_list & self.black_list
-        if overlap:
-            raise ValueError(f"ops in both white and black lists: {overlap}")

@@ -51,15 +51,24 @@ def _cast_input(block, op_idx, name, dest_dtype, cache):
 
 
 def rewrite_program(main_program: Program, amp_lists, dest_dtype="bfloat16"):
-    """Walk the (forward) op list, casting white-op inputs to `dest_dtype` and
-    black-op inputs back to float32. Returns the number of casts inserted.
-    Must run BEFORE append_backward so grad ops derive through the casts."""
-    block = main_program.global_block
+    """Walk every block's (forward) op list, casting white-op inputs to
+    `dest_dtype` and black-op inputs back to float32. Returns the number of
+    casts inserted. Must run BEFORE append_backward so grad ops derive
+    through the casts. Control-flow sub-blocks are rewritten too — the FLOPs
+    of an RNN/scan model live there."""
+    n_casts = 0
+    for block in main_program.blocks:
+        n_casts += _rewrite_block(block, amp_lists, dest_dtype)
+    main_program._bump_version()
+    return n_casts
+
+
+def _rewrite_block(block, amp_lists, dest_dtype):
+    from ...ops.registry import infer_op
+
     cache: dict = {}
     i = 0
     n_casts = 0
-    from ...ops.registry import infer_op
-
     while i < len(block.ops):
         op = block.ops[i]
         target = None
@@ -72,6 +81,7 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype="bfloat16"):
             # propagates through metadata — otherwise a black op downstream
             # of white->gray sees stale fp32 metadata and never casts back
             infer_op(op, block)
+            _invalidate(cache, op)
             i += 1
             continue
         inserted_here = 0
@@ -88,7 +98,17 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype="bfloat16"):
             op.inputs[slot] = new_names
         # re-infer this op's output dtype under the new input dtypes
         infer_op(op, block)
+        _invalidate(cache, op)
         n_casts += inserted_here
         i += 1
-    main_program._bump_version()
     return n_casts
+
+
+def _invalidate(cache: dict, op):
+    """A redefined var's cached low-precision view is stale — drop it so the
+    next consumer re-casts the NEW value."""
+    for out in op.output_names:
+        if not out:
+            continue
+        for key in [k for k in cache if k[0] == out]:
+            del cache[key]

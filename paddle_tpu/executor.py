@@ -154,9 +154,10 @@ def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env
         env.update(zip(feed_names, feed_vals))
 
         def lowerer(block_idx):
-            # control-flow sub-block lowering hook (while/cond ops)
+            # control-flow sub-block lowering hook (while/cond ops); the RNG
+            # key arrives via sub_env['__rng_key'] set by the control-flow op
             sub = block.program.blocks[block_idx]
-            return lambda sub_env: _run_ops_traced(sub, sub_env, key)
+            return lambda sub_env: _run_ops_traced(sub, sub_env)
 
         for op in ops:
             opdef = get_op_def(op.type)
@@ -181,14 +182,27 @@ def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env
     return fn
 
 
-def _run_ops_traced(block, env, key):
-    """Trace a sub-block's ops against an existing env (control flow)."""
+def _run_ops_traced(block, env, key=None):
+    """Trace a sub-block's ops against an existing env (control flow).
+    Provides its own lowerer so control-flow ops nest arbitrarily. The RNG
+    key threads through env['__rng_key'] (control-flow ops place a fresh
+    per-iteration key there) and the evolved key is written back so nested
+    randomness never repeats."""
+    key = env.pop("__rng_key", key)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def lowerer(block_idx):
+        sub = block.program.blocks[block_idx]
+        return lambda sub_env: _run_ops_traced(sub, sub_env)
+
     for op in block.ops:
         opdef = get_op_def(op.type)
         rng = None
         if opdef.needs_rng:
             key, rng = jax.random.split(key)
-        ctx = ExecContext(op, env, rng=rng)
+        env["__rng_key"] = key
+        ctx = ExecContext(op, env, rng=rng, lowerer=lowerer)
         outs = opdef.compute(ctx)
         for slot, val in outs.items():
             names = op.outputs.get(slot, [])

@@ -1,0 +1,356 @@
+"""Control-flow layers: While, cond, Switch, StaticRNN.
+
+Reference: /root/reference/python/paddle/fluid/layers/control_flow.py
+(While:698, Switch:1622, StaticRNN:318, ConditionalBlock:1471; DynamicRNN is
+LoD-based and intentionally absent — padded static_rnn + segment masks replace
+it, SURVEY.md §5 long-context notes)."""
+from __future__ import annotations
+
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["While", "cond", "Switch", "StaticRNN", "less_than", "less_equal",
+           "greater_than", "greater_equal", "equal", "not_equal",
+           "logical_and", "logical_or", "logical_not", "logical_xor"]
+
+
+def _compare(op_type, x, y, cond=None):
+    """Comparison layer with the reference's optional in-place `cond` output
+    (control_flow.py less_than:1007 etc.) — While loops re-assign their
+    condition var through it."""
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op(op_type, {"X": [x], "Y": [y]}, {"Out": [cond]}, {})
+    return cond
+
+
+def less_than(x, y, cond=None, force_cpu=None):
+    return _compare("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def _logical(op_type, x, y, out=None):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    ins = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
+    helper.append_op(op_type, ins, {"Out": [out]}, {})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical("logical_not", x, None, out)
+
+
+class BlockGuard:
+    """Enter a fresh sub-block of the main program (reference
+    control_flow.py:BlockGuard:24)."""
+
+    def __init__(self, program=None):
+        self.program = program or default_main_program()
+
+    def __enter__(self):
+        self.block = self.program._create_block()
+        return self.block
+
+    def __exit__(self, exc_type, *a):
+        self.program._rollback()
+        return False
+
+
+def _block_io(sub_block, parent_block):
+    """(reads-from-parent, writes-visible-in-parent) name sets."""
+    defined_inside = set()
+    reads, writes = [], []
+    for op in sub_block.ops:
+        for n in op.input_names:
+            if n and n not in defined_inside and n not in reads:
+                if parent_block.has_var(n) and n not in sub_block.vars:
+                    reads.append(n)
+        for n in op.output_names:
+            if n:
+                defined_inside.add(n)
+                if (parent_block.has_var(n) and n not in sub_block.vars
+                        and n not in writes):
+                    writes.append(n)
+    return reads, writes
+
+
+class While:
+    """fluid.layers.While (control_flow.py:698):
+
+        cond = L.less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ... body ops, must re-assign `cond` ...
+    """
+
+    def __init__(self, cond: Variable, is_test=False, name=None):
+        if cond.dtype.value != "bool":
+            raise TypeError("While condition must be a bool Variable")
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    def block(self):
+        return _WhileGuard(self)
+
+
+class _WhileGuard(BlockGuard):
+    def __init__(self, while_op: While):
+        super().__init__()
+        self.while_op = while_op
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            return super().__exit__(exc_type, *a)
+        sub_block = self.block
+        super().__exit__(exc_type, *a)
+        parent = default_main_program().current_block()
+        reads, writes = _block_io(sub_block, parent)
+        cond_name = self.while_op.cond_var.name
+        carried = [n for n in writes]
+        if cond_name not in carried:
+            carried.append(cond_name)
+        # Deps: names READ by the body from the outer scope — listed as inputs
+        # so the executor's def-use analysis pulls them into the traced env
+        # (the body closes over them; they are not loop-carried)
+        deps = [n for n in reads if n not in carried]
+        parent.append_op(
+            "while",
+            {"X": carried, "Condition": [cond_name], "Deps": deps},
+            {"Out": carried},
+            {"sub_block": sub_block.idx, "dep_names": deps},
+        )
+        return False
+
+
+def cond(pred: Variable, true_fn, false_fn=None, name=None):
+    """Functional conditional (XLA-native): trace both branches into
+    sub-blocks, select with lax.cond. Branch fns take no args and return a
+    Variable or tuple of Variables of matching shapes/dtypes."""
+    if false_fn is None:
+        raise ValueError(
+            "cond() requires both branches (XLA traces both); for the "
+            "run-only-if-true pattern use conditional_block with outputs "
+            "assigned before the block")
+    helper = LayerHelper("cond", name=name)
+    program = default_main_program()
+
+    with BlockGuard(program) as tb:
+        t_out = true_fn()
+        t_outs = list(t_out) if isinstance(t_out, (list, tuple)) else [t_out]
+    with BlockGuard(program) as fb:
+        f_out = false_fn()
+        f_outs = list(f_out) if isinstance(f_out, (list, tuple)) else [f_out]
+    if len(f_outs) != len(t_outs):
+        raise ValueError("true_fn and false_fn must return the same arity")
+
+    parent = program.current_block()
+    outs = [
+        parent.create_var(
+            name=helper.name + f".out{i}", shape=v.shape, dtype=v.dtype
+        )
+        for i, v in enumerate(t_outs)
+    ]
+    # bridge: sub-block results assigned to the op's Out names inside blocks
+    for blk, branch_outs in ((tb, t_outs), (fb, f_outs)):
+        for o, src in zip(outs, branch_outs):
+            blk.append_op("assign", {"X": [src.name]}, {"Out": [o.name]}, {})
+    deps, _ = _block_io(tb, parent)
+    f_deps, _ = _block_io(fb, parent)
+    deps = deps + [n for n in f_deps if n not in deps]
+    parent.append_op(
+        "conditional_block",
+        {"Cond": [pred.name], "Deps": deps},
+        {"Out": [o.name for o in outs]},
+        {"sub_block": tb.idx, "sub_block_false": fb.idx, "dep_names": deps},
+    )
+    return outs[0] if len(outs) == 1 else outs
+
+
+class Switch:
+    """Reference Switch (control_flow.py:1622): a case ladder used mainly by
+    LR warmup schedules. Implemented as nested functional conds at build
+    time: each case's ops run in a sub-block."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []  # (pred_var or None, fn)
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+
+class _SwitchCase:
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    def __enter__(self):
+        raise NotImplementedError(
+            "Switch with-block syntax needs deferred assign support; use "
+            "layers.cond(pred, true_fn, false_fn) or piecewise_decay/"
+            "linear_lr_warmup which are already branchless")
+
+    def __exit__(self, *a):
+        return False
+
+
+class StaticRNN:
+    """Reference StaticRNN (control_flow.py:318) lowered to lax.scan.
+
+        rnn = StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x_seq)   # x_seq: time-major [T, B, D]
+            prev = rnn.memory(init=h0)
+            h = L.fc([word, prev], size=H, act="tanh")
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()   # [T, B, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._guard = None
+        self._step_inputs = []   # (outer var, inner var)
+        self._memories = []      # (init var, pre var, post var or None@idx)
+        self._outputs = []       # inner per-step vars
+        self._built = False
+        self._out_vars = None
+
+    def step(self):
+        self._guard = BlockGuard()
+        return _StaticRNNGuard(self)
+
+    # -- inside-step API ----------------------------------------------------
+    def step_input(self, x: Variable) -> Variable:
+        blk = default_main_program().current_block()
+        inner = blk.create_var(
+            name=self.helper.name + f".in{len(self._step_inputs)}",
+            shape=x.shape[1:], dtype=x.dtype)
+        self._step_inputs.append((x, inner))
+        return inner
+
+    def memory(self, init: Variable) -> Variable:
+        blk = default_main_program().current_block()
+        if init.name in blk.vars:
+            raise ValueError(
+                f"StaticRNN memory init '{init.name}' was created inside the "
+                f"step block; create it before rnn.step() so it has a value "
+                f"at loop entry")
+        pre = blk.create_var(
+            name=self.helper.name + f".mem{len(self._memories)}",
+            shape=init.shape, dtype=init.dtype)
+        self._memories.append([init, pre, None])
+        return pre
+
+    def update_memory(self, mem: Variable, new: Variable):
+        for m in self._memories:
+            if m[1].name == mem.name:
+                m[2] = new
+                return
+        raise ValueError(f"{mem.name} is not a StaticRNN memory")
+
+    def step_output(self, out: Variable):
+        self._outputs.append(out)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # -- finalize -----------------------------------------------------------
+    def _build(self, sub_block):
+        parent = default_main_program().current_block()
+        for m in self._memories:
+            if m[2] is None:
+                raise ValueError(
+                    f"memory {m[1].name} never update_memory()'d")
+        if not self._step_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        T = self._step_inputs[0][0].shape[0]
+        outs = []
+        for i, o in enumerate(self._outputs):
+            outs.append(parent.create_var(
+                name=self.helper.name + f".out{i}",
+                shape=(T,) + tuple(o.shape), dtype=o.dtype))
+        finals = [
+            parent.create_var(name=self.helper.name + f".final{i}",
+                              shape=m[0].shape, dtype=m[0].dtype)
+            for i, m in enumerate(self._memories)
+        ]
+        deps, _ = _block_io(sub_block, parent)
+        inner = {i.name for _, i in self._step_inputs} | {m[1].name for m in self._memories}
+        deps = [n for n in deps if n not in inner]
+        parent.append_op(
+            "static_rnn",
+            {"StepInputs": [x.name for x, _ in self._step_inputs],
+             "InitMemories": [m[0].name for m in self._memories],
+             "Deps": deps},
+            {"Outputs": [o.name for o in outs],
+             "FinalMemories": [f.name for f in finals]},
+            {"sub_block": sub_block.idx,
+             "dep_names": deps,
+             "step_input_names": [i.name for _, i in self._step_inputs],
+             "pre_names": [m[1].name for m in self._memories],
+             "post_names": [m[2].name for m in self._memories],
+             "output_names": [o.name for o in self._outputs]},
+        )
+        self._out_vars = outs
+        self._built = True
+
+    def __call__(self):
+        if not self._built:
+            raise RuntimeError("call after the step() block closes")
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+
+class _StaticRNNGuard:
+    def __init__(self, rnn: StaticRNN):
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.block = self.rnn._guard.__enter__()
+        return self.rnn
+
+    def __exit__(self, exc_type, *a):
+        self.rnn._guard.__exit__(exc_type, *a)
+        if exc_type is None:
+            self.rnn._build(self.block)
+        return False

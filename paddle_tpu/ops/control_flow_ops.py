@@ -1,0 +1,153 @@
+"""Control-flow ops lowered to XLA structured control flow.
+
+TPU-native re-design of the reference's scope-based interpreted loops:
+  * while_op.cc (WhileOp::RunImpl runs the sub-block per iteration against a
+    step scope) -> lax.while_loop over a carried tuple of named values
+  * conditional_block_op.cc -> lax.cond (both branches traced; the false
+    branch passes prior values through, so outputs must pre-exist)
+  * recurrent_op.cc / StaticRNN -> lax.scan (time-major), which is
+    REVERSE-DIFFERENTIABLE — the derived vjp grad (registry.py) gives
+    backprop-through-time for free, replacing the reference's hand-built
+    while_grad machinery (backward.py + while_op grad).
+
+`while` itself is forward-only (lax.while_loop has no reverse rule); training
+recurrences should use static_rnn/scan, matching XLA semantics (SURVEY.md §7
+hard part (a)).
+
+All carried values must keep static shape/dtype across iterations — that is
+the XLA contract; ragged loops belong in host code or padded tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ExecContext, register_op
+
+_INTERNAL_KEYS = ("__axis_env__", "__rng_key")
+
+
+def _outer_env(ctx: ExecContext) -> dict:
+    env = {k: v for k, v in ctx.env.items() if k not in _INTERNAL_KEYS}
+    # Deps values may arrive under synthetic slot names (the derived-vjp grad
+    # re-runs this compute through a shim whose env only holds per-slot fake
+    # names) — rebind them to the REAL names the sub-block ops reference,
+    # which travel via the dep_names attr.
+    dep_names = ctx.attr("dep_names", None)
+    if dep_names:
+        for name, val in zip(dep_names, ctx.inputs("Deps")):
+            if val is not None:
+                env[name] = val
+    return env
+
+
+def _op_rng(ctx: ExecContext):
+    return ctx.rng if ctx.rng is not None else jax.random.PRNGKey(0)
+
+
+@register_op("while", grad="none", needs_rng=True)
+def while_op(ctx: ExecContext):
+    """inputs: X = carried var names (incl. the condition's producers' deps),
+    Condition = [cond var]; attrs: sub_block; outputs: Out = carried names.
+    The RNG key is loop-carried so randomness differs per iteration."""
+    sub_idx = ctx.attr("sub_block")
+    run_block = ctx.lowerer(sub_idx)
+    cond_name = ctx.op.inputs["Condition"][0]
+    carry_names = list(ctx.op.inputs.get("X", []))
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+    base_env = _outer_env(ctx)
+    init_vals = tuple(jnp.asarray(ctx.env[n]) for n in carry_names)
+    init = init_vals + (_op_rng(ctx),)
+
+    def cond_fun(carry):
+        env = dict(zip(carry_names, carry[:-1]))
+        return jnp.reshape(env[cond_name], ()).astype(jnp.bool_)
+
+    def body_fun(carry):
+        env = dict(base_env)
+        env.update(zip(carry_names, carry[:-1]))
+        env["__rng_key"] = carry[-1]
+        env = run_block(env)
+        vals = tuple(
+            jnp.asarray(env[n]).astype(i.dtype).reshape(i.shape)
+            for n, i in zip(carry_names, init_vals)
+        )
+        return vals + (env.get("__rng_key", carry[-1]),)
+
+    final = jax.lax.while_loop(cond_fun, body_fun, init)
+    out_names = ctx.op.outputs.get("Out", [])
+    result = dict(zip(carry_names, final[:-1]))
+    return {"Out": [result.get(n) for n in out_names]}
+
+
+@register_op("conditional_block", needs_rng=True)
+def conditional_block(ctx: ExecContext):
+    """inputs: Cond=[pred], X=[carried]; attrs: sub_block (+ optional
+    sub_block_false); outputs: Out. With no false block, Out vars keep their
+    prior values when pred is false (so they must already have values)."""
+    pred = jnp.reshape(ctx.input("Cond"), ()).astype(jnp.bool_)
+    out_names = ctx.op.outputs.get("Out", [])
+    base_env = _outer_env(ctx)
+    run_true = ctx.lowerer(ctx.attr("sub_block"))
+    false_idx = ctx.attr("sub_block_false", None)
+    run_false = ctx.lowerer(false_idx) if false_idx is not None else None
+
+    key = _op_rng(ctx)
+
+    def tb(_):
+        env = dict(base_env)
+        env["__rng_key"] = jax.random.fold_in(key, 0)
+        env = run_true(env)
+        return tuple(jnp.asarray(env[n]) for n in out_names)
+
+    def fb(_):
+        if run_false is not None:
+            env = dict(base_env)
+            env["__rng_key"] = jax.random.fold_in(key, 1)
+            env = run_false(env)
+            return tuple(jnp.asarray(env[n]) for n in out_names)
+        missing = [n for n in out_names if n not in base_env]
+        if missing:
+            raise ValueError(
+                f"conditional_block outputs {missing} have no prior value for "
+                f"the false branch — assign them before the block or provide "
+                f"a false block")
+        return tuple(jnp.asarray(base_env[n]) for n in out_names)
+
+    outs = jax.lax.cond(pred, tb, fb, None)
+    return {"Out": list(outs)}
+
+
+@register_op("static_rnn", needs_rng=True)
+def static_rnn(ctx: ExecContext):
+    """inputs: StepInputs (time-major [T, ...] arrays), InitMemories;
+    attrs: sub_block, step_input_names (per-step var names inside the block),
+    pre_names / post_names (memory pairs), output_names (per-step outputs);
+    outputs: Outputs (stacked [T, ...]), FinalMemories."""
+    run_block = ctx.lowerer(ctx.attr("sub_block"))
+    step_in_names = list(ctx.attr("step_input_names", []))
+    pre_names = list(ctx.attr("pre_names", []))
+    post_names = list(ctx.attr("post_names", []))
+    out_names = list(ctx.attr("output_names", []))
+    xs = tuple(jnp.asarray(x) for x in ctx.inputs("StepInputs"))
+    mems = tuple(jnp.asarray(m) for m in ctx.inputs("InitMemories"))
+    base_env = _outer_env(ctx)
+    T = xs[0].shape[0]
+    step_keys = jax.random.split(_op_rng(ctx), T)  # per-timestep randomness
+
+    def body(carry, x_t):
+        env = dict(base_env)
+        env.update(zip(pre_names, carry))
+        env.update(zip(step_in_names, x_t[:-1]))
+        env["__rng_key"] = x_t[-1]
+        env = run_block(env)
+        new_carry = tuple(
+            jnp.asarray(env[p]).astype(c.dtype).reshape(c.shape)
+            for p, c in zip(post_names, carry)
+        )
+        ys = tuple(env[n] for n in out_names)
+        return new_carry, ys
+
+    final_mems, stacked = jax.lax.scan(body, mems, xs + (step_keys,))
+    return {"Outputs": list(stacked), "FinalMemories": list(final_mems)}

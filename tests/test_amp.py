@@ -110,3 +110,29 @@ def test_custom_lists_override():
     with pytest.raises(ValueError):
         amp.AutoMixedPrecisionLists(custom_white_list={"softmax"},
                                     custom_black_list={"softmax"})
+
+
+def test_amp_rewrites_control_flow_sub_blocks():
+    """White ops inside a StaticRNN scan body must get bf16 casts too."""
+    from paddle_tpu.layers import tensor as T
+    T_, B, D, H = 3, 2, 4, 5
+    x = L.data(name="xs", shape=[B, D], dtype="float32")
+    h0 = T.fill_constant([B, H], "float32", 0.0)
+    rnn = L.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        prev = rnn.memory(init=h0)
+        h = L.fc([x_t, prev], size=H, act="tanh")
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    loss = L.mean(rnn())
+    main = pt.default_main_program()
+    amp.rewrite_program(main, amp.AutoMixedPrecisionLists(), "bfloat16")
+    sub_blocks = main.blocks[1:]
+    assert any(op.type == "cast" for b in sub_blocks for op in b.ops)
+    # and the rewritten program still runs
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (lv,) = exe.run(main, feed={"xs": np.ones((T_, B, D), np.float32)},
+                    fetch_list=[loss])
+    assert np.isfinite(float(lv))

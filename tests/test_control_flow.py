@@ -1,0 +1,172 @@
+"""Control-flow tests: While -> lax.while_loop, cond -> lax.cond,
+StaticRNN -> lax.scan incl. backward-through-time (reference
+unittests/test_while_op.py, test_cond.py-era, test_recurrent_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.layers import tensor as T
+
+
+def test_while_accumulates():
+    """sum 0..9 with a While loop."""
+    i = T.fill_constant(shape=[1], dtype="int64", value=0)
+    n = T.fill_constant(shape=[1], dtype="int64", value=10)
+    acc = T.fill_constant(shape=[1], dtype="int64", value=0)
+    cond = L.less_than(i, n)
+    w = L.While(cond)
+    with w.block():
+        tmp = L.elementwise_add(acc, i)
+        L.assign(tmp, acc)
+        L.increment(i, value=1, in_place=True)
+        L.less_than(i, n, cond=cond)
+    exe = pt.Executor()
+    (out,) = exe.run(pt.default_main_program(), feed={}, fetch_list=[acc])
+    assert int(np.asarray(out).reshape(-1)[0]) == 45
+
+
+def test_while_reads_outer_var():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    i = T.fill_constant(shape=[1], dtype="int64", value=0)
+    n = T.fill_constant(shape=[1], dtype="int64", value=3)
+    acc = T.fill_constant(shape=[1, 4], dtype="float32", value=0.0)
+    cond = L.less_than(i, n)
+    w = L.While(cond)
+    with w.block():
+        s = L.reduce_sum(x, dim=0, keep_dim=True)  # outer read, not carried
+        L.assign(L.elementwise_add(acc, s), acc)
+        L.increment(i, value=1, in_place=True)
+        L.less_than(i, n, cond=cond)
+    exe = pt.Executor()
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (out,) = exe.run(pt.default_main_program(), feed={"x": xv},
+                     fetch_list=[acc])
+    np.testing.assert_allclose(np.asarray(out), 3 * xv.sum(0, keepdims=True))
+
+
+def test_cond_selects_branch():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    pred_in = L.data(name="p", shape=[], dtype="bool")
+    out = L.cond(pred_in,
+                 lambda: L.scale(x, scale=2.0),
+                 lambda: L.scale(x, scale=-1.0))
+    exe = pt.Executor()
+    xv = np.ones((2, 4), np.float32)
+    (got_t,) = exe.run(pt.default_main_program(),
+                       feed={"x": xv, "p": np.asarray(True)}, fetch_list=[out])
+    (got_f,) = exe.run(pt.default_main_program(),
+                       feed={"x": xv, "p": np.asarray(False)}, fetch_list=[out])
+    np.testing.assert_allclose(got_t, 2 * xv)
+    np.testing.assert_allclose(got_f, -xv)
+
+
+def test_static_rnn_forward_matches_numpy():
+    T_, B, D, H = 5, 2, 3, 4
+    x = L.data(name="x", shape=[B, D], dtype="float32")  # time-major [T,B,D]
+    h0 = L.data(name="h0", shape=[H], dtype="float32")
+
+    rnn = L.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        prev = rnn.memory(init=h0)
+        h = L.tanh(L.elementwise_add(
+            L.matmul(x_t, T.fill_constant([D, H], "float32", 0.1)),
+            prev))
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn()
+
+    exe = pt.Executor()
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((T_, B, D)).astype(np.float32)
+    h0v = np.zeros((B, H), np.float32)
+    (got,) = exe.run(pt.default_main_program(), feed={"x": xv, "h0": h0v},
+                     fetch_list=[out])
+    want = []
+    h = h0v
+    for t in range(T_):
+        h = np.tanh(xv[t] @ np.full((D, H), 0.1, np.float32) + h)
+        want.append(h)
+    np.testing.assert_allclose(np.asarray(got), np.stack(want), rtol=1e-5)
+
+
+def test_static_rnn_trains_bptt():
+    """Gradient flows through lax.scan: train a tiny RNN to fit a target."""
+    T_, B, D, H = 4, 8, 3, 5
+    x = L.data(name="x", shape=[B, D], dtype="float32")
+    y = L.data(name="y", shape=[H], dtype="float32")
+    h0 = T.fill_constant([B, H], "float32", 0.0)
+
+    rnn = L.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        prev = rnn.memory(init=h0)
+        h = L.fc([x_t, prev], size=H, act="tanh")
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    outs = rnn()  # [T, B, H]
+    last = L.squeeze(L.slice(outs, axes=[0], starts=[T_ - 1], ends=[T_]),
+                     axes=[0])
+    loss = L.mean(L.square_error_cost(last, y))
+    pt.optimizer.Adam(0.01).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((T_, B, D)).astype(np.float32)
+    yv = rng.standard_normal((B, H)).astype(np.float32) * 0.5
+    hist = []
+    for _ in range(40):
+        (lv,) = exe.run(pt.default_main_program(), feed={"x": xv, "y": yv},
+                        fetch_list=[loss])
+        hist.append(float(lv))
+    assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
+
+
+def test_while_requires_bool_cond():
+    i = T.fill_constant(shape=[1], dtype="int64", value=0)
+    with pytest.raises(TypeError):
+        L.While(i)
+
+
+def test_while_on_grad_path_raises():
+    """A While between params and loss must raise, not silently freeze."""
+    x = L.data(name="xg", shape=[4], dtype="float32")
+    h = L.fc(x, size=4)
+    i = T.fill_constant(shape=[1], dtype="int64", value=0)
+    n = T.fill_constant(shape=[1], dtype="int64", value=2)
+    acc = T.fill_constant(shape=[1, 4], dtype="float32", value=0.0)
+    cnd = L.less_than(i, n)
+    w = L.While(cnd)
+    with w.block():
+        L.assign(L.elementwise_add(acc, h), acc)
+        L.increment(i, value=1, in_place=True)
+        L.less_than(i, n, cond=cnd)
+    loss = L.mean(acc)
+    with pytest.raises(RuntimeError, match="gradient path"):
+        pt.optimizer.SGD(0.1).minimize(loss)
+
+
+def test_static_rnn_dropout_varies_per_step():
+    """Per-timestep RNG: dropout masks must differ across scan steps."""
+    T_, B, D = 6, 2, 64
+    x = L.data(name="xr", shape=[B, D], dtype="float32")
+    m0 = T.fill_constant([B, D], "float32", 0.0)
+    rnn = L.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        d = L.dropout(x_t, dropout_prob=0.5,
+                      dropout_implementation="upscale_in_train")
+        mem = rnn.memory(init=m0)
+        rnn.update_memory(mem, d)
+        rnn.step_output(d)
+    outs = rnn()
+    exe = pt.Executor()
+    xv = np.ones((T_, B, D), np.float32)
+    (got,) = exe.run(pt.default_main_program(), feed={"xr": xv},
+                     fetch_list=[outs])
+    got = np.asarray(got)
+    masks = (got != 0).reshape(T_, -1)
+    # adjacent steps must not share the identical mask
+    assert not all((masks[t] == masks[0]).all() for t in range(1, T_))

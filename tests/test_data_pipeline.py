@@ -140,15 +140,19 @@ def test_wmt16_tuple_order():
 
 def test_xmap_abandoned_iteration_stops_workers():
     import threading
+    import time
     base = threading.active_count()
     xm = R.xmap_readers(lambda x: x, lambda: iter(range(1000)),
                         process_num=3, buffer_size=2)
     it = xm()
     next(it)
     it.close()  # abandon
-    import time
-    time.sleep(0.5)
-    assert threading.active_count() <= base + 1  # threads wound down
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if threading.active_count() <= base:
+            break
+        time.sleep(0.05)
+    assert threading.active_count() <= base, "worker threads did not wind down"
 
 
 def test_imdb_honors_custom_word_idx():
