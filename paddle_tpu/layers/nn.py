@@ -16,6 +16,8 @@ from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "fused_attention",
+    "ring_attention",
     "fc",
     "embedding",
     "conv2d",
@@ -1002,3 +1004,38 @@ def lod_reset(x, y=None, target_lod=None):
     """LoD is replaced by padding + segment ids on TPU (SURVEY.md §5); this is
     an identity kept for API compatibility."""
     return x
+
+
+def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None, name=None):
+    """Fused (flash) scaled-dot-product attention over [B, nh, S, dh] tensors
+    (Pallas kernel on TPU, O(S) memory; see ops/attention_ops.py). The
+    reference builds attention from matmul+softmax ops (nets.py:345) — this
+    is the TPU-native fused equivalent."""
+    helper = LayerHelper("fused_attention", name=name)
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        "fused_attention", inputs, {"Out": [out]},
+        {"causal": causal, "sm_scale": float(sm_scale)},
+    )
+    return out
+
+
+def ring_attention(q, k, v, causal=False, sm_scale=None, ring_id=0, name=None):
+    """Sequence-parallel ring attention: exact attention over a sequence
+    sharded across the mesh axis bound to `ring_id` (K/V blocks rotate via
+    collective-permute with an online-softmax merge). Single-device: plain
+    fused attention."""
+    helper = LayerHelper("ring_attention", name=name)
+    if sm_scale is None:
+        sm_scale = float(q.shape[-1]) ** -0.5
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        "ring_attention", {"Q": [q], "K": [k], "V": [v]}, {"Out": [out]},
+        {"causal": causal, "sm_scale": float(sm_scale), "ring_id": ring_id},
+    )
+    return out

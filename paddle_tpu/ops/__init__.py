@@ -18,3 +18,4 @@ from . import nn_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import attention_ops  # noqa: F401

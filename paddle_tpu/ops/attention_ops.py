@@ -1,0 +1,149 @@
+"""Fused attention ops: Pallas flash attention + ring attention (SP).
+
+The reference has no fused attention — its scaled_dot_product_attention
+(nets.py:345) materializes the full [B,nh,S,S] score matrix through separate
+matmul/softmax/dropout ops. On TPU the fused kernel is the single biggest
+HBM-traffic win for transformers (SURVEY.md §2.3 row "ring attention"), so:
+
+  * `fused_attention` lowers to jax's bundled Pallas TPU flash-attention
+    kernel (jax.experimental.pallas.ops.tpu.flash_attention — public JAX
+    code, O(S) memory, fwd+bwd kernels with custom VJP). Off-TPU it falls
+    back to a straightforward jnp reference with identical semantics.
+  * `ring_attention` is the sequence-parallel form: K/V shards rotate around
+    the `sp` mesh axis via collective-permute while each device keeps a
+    running online-softmax merge (m, l, acc). Pure differentiable jnp +
+    lax.ppermute — XLA overlaps the permute with the local block math over
+    ICI. Used under shard_map (CompiledProgram.with_collective) or inside
+    GSPMD manual regions; with no axis bound it degrades to fused_attention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .collective_ops import _axis
+from .registry import ExecContext, register_op
+
+_NEG_INF = -1e9
+
+
+def _reference_attention(q, k, v, bias=None, causal=False, sm_scale=1.0):
+    """Plain jnp attention, the numeric oracle (and CPU path).
+    q,k,v: [B, nh, S, dh]."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), sk - sq)
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(probs.dtype))
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _block_multiple_ok(s: int) -> bool:
+    # the bundled kernel wants seq divisible by its block sizes (>=128 lanes)
+    return s % 128 == 0
+
+
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0):
+    """Dispatch: Pallas kernel on TPU for well-shaped inputs, else reference."""
+    B, nh, sq, dh = q.shape
+    sk = k.shape[2]
+    if (_on_tpu() and _block_multiple_ok(sq) and _block_multiple_ok(sk)
+            and q.dtype != jnp.float64):
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+        return fa.flash_attention(q, k, v, ab=bias, causal=causal,
+                                  sm_scale=float(sm_scale))
+    return _reference_attention(q, k, v, bias, causal, sm_scale)
+
+
+@register_op("fused_attention")
+def fused_attention(ctx: ExecContext):
+    """inputs: Q, K, V [B, nh, S, dh], optional Bias (broadcastable to
+    [B, nh, Sq, Sk]); attrs: causal, sm_scale. Output: [B, nh, Sq, dh]."""
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
+    out = flash_attention(q, k, v, bias,
+                          causal=ctx.attr("causal", False),
+                          sm_scale=ctx.attr("sm_scale", 1.0))
+    return {"Out": out.astype(q.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence parallelism over the `sp` axis)
+# ---------------------------------------------------------------------------
+
+
+def ring_attention_local(q, k, v, axis_name, causal=False, sm_scale=1.0):
+    """Blockwise ring attention (Liu et al., Ring Attention; public
+    algorithm). Each device holds the full batch/head dims but a 1/p slice of
+    the sequence. K/V blocks rotate p times around `axis_name`; the local
+    online-softmax state (acc, m, l) merges each incoming block, giving exact
+    softmax attention over the full sequence with O(S/p) memory per device.
+
+    q, k, v: [B, nh, S_local, dh] (this device's shard). Causal masking uses
+    the ring rank to compute each block's global offset.
+    """
+    p = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, nh, s_loc, dh = q.shape
+    q32 = q.astype(jnp.float32) * sm_scale
+
+    def block_scores(kb, src_rank):
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, kb.astype(jnp.float32))
+        if causal:
+            q_pos = rank * s_loc + jnp.arange(s_loc)[:, None]
+            k_pos = src_rank * s_loc + jnp.arange(s_loc)[None, :]
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        return scores
+
+    def step(carry, _):
+        acc, m, l, kb, vb, src = carry
+        s = block_scores(kb, src)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + pexp.sum(-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", pexp, vb.astype(jnp.float32))
+        # rotate kv to the next device on the ring
+        perm = [(i, (i + 1) % p) for i in range(p)]
+        kb_next = jax.lax.ppermute(kb, axis_name, perm)
+        vb_next = jax.lax.ppermute(vb, axis_name, perm)
+        src_next = (src - 1) % p
+        return (acc_new, m_new, l_new, kb_next, vb_next, src_next), None
+
+    acc0 = jnp.zeros((B, nh, s_loc, dh), jnp.float32)
+    m0 = jnp.full((B, nh, s_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, nh, s_loc), jnp.float32)
+    (acc, m, l, _, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v, rank), None, length=p)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+@register_op("ring_attention")
+def ring_attention(ctx: ExecContext):
+    """Sequence-parallel attention over the axis bound to `ring_id` (shard_map
+    regime). With no axis bound (single device / GSPMD handles it), falls back
+    to fused_attention semantics on the local (full) sequence."""
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    causal = ctx.attr("causal", False)
+    sm_scale = ctx.attr("sm_scale", 1.0)
+    axis = _axis(ctx)
+    if axis is None:
+        out = flash_attention(q, k, v, None, causal=causal, sm_scale=sm_scale)
+    else:
+        out = ring_attention_local(q, k, v, axis, causal=causal,
+                                   sm_scale=sm_scale)
+    return {"Out": out.astype(q.dtype)}
