@@ -77,9 +77,11 @@ def main():
     tokens = batch * seq_len
     tok_per_sec = tokens / dt
 
-    # parameter count (embeddings + L layers + head)
+    # matmul-participating parameter count: word/position embedding tables are
+    # lookups, not matmuls, so they are EXCLUDED from the 6N term; the lm_head
+    # projection (H*V) is a real matmul and stays.
     H, L_, F, V = cfg.hidden_size, cfg.num_layers, cfg.ffn_size, cfg.vocab_size
-    n_params = V * H + cfg.max_position * H + L_ * (4 * H * H + 2 * H * F) + H * V
+    n_params = L_ * (4 * H * H + 2 * H * F) + H * V
     # fwd+bwd matmul flops ~ 6*N*T; attention adds 12*L*H*S^2 per token-pair term
     step_flops = 6 * n_params * tokens + 12 * L_ * H * seq_len * tokens
     mfu = (step_flops / dt) / _peak_flops(dev)

@@ -27,7 +27,14 @@ def _read_batches(tar_path: str, want_train: bool, label_key: str):
             if (want_train and is_train) or (not want_train and is_test):
                 d = pickle.load(tf.extractfile(member), encoding="bytes")
                 data = d[b"data"].astype(np.float32) / 255.0
-                labels = d.get(label_key.encode()) or d.get(b"labels") or d.get(b"fine_labels")
+                labels = next(
+                    (v for k in (label_key.encode(), b"labels", b"fine_labels")
+                     if (v := d.get(k)) is not None),
+                    None,
+                )
+                if labels is None:
+                    raise KeyError(
+                        f"no label key in cifar batch: {sorted(d.keys())}")
                 for row, lab in zip(data, labels):
                     yield row, int(lab)
 

@@ -178,6 +178,19 @@ def cond(pred: Variable, true_fn, false_fn=None, name=None):
         raise ValueError("true_fn and false_fn must return the same arity")
 
     parent = program.current_block()
+    # A branch that assigns to an outer-scope var (reference ConditionalBlock
+    # mutates the scope in place) cannot take conditional effect under
+    # lax.cond's functional tracing — only declared return values propagate.
+    # Fail loudly instead of silently discarding the write. Checked BEFORE the
+    # bridge assigns below (which legitimately write parent-scope out vars).
+    _, t_writes = _block_io(tb, parent)
+    _, f_writes = _block_io(fb, parent)
+    outer_writes = sorted(set(t_writes) | set(f_writes))
+    if outer_writes:
+        raise ValueError(
+            f"cond() branch assigns to outer-scope variable(s) {outer_writes}; "
+            "such writes are not propagated (both branches are traced "
+            "functionally). Return the value from the branch fn instead.")
     outs = [
         parent.create_var(
             name=helper.name + f".out{i}", shape=v.shape, dtype=v.dtype
@@ -188,6 +201,9 @@ def cond(pred: Variable, true_fn, false_fn=None, name=None):
     for blk, branch_outs in ((tb, t_outs), (fb, f_outs)):
         for o, src in zip(outs, branch_outs):
             blk.append_op("assign", {"X": [src.name]}, {"Out": [o.name]}, {})
+    # Deps AFTER the bridge: a branch fn may return an outer-scope var
+    # directly (its only read is the bridge assign itself), and it still must
+    # reach the sub-block env via Deps/dep_names.
     deps, _ = _block_io(tb, parent)
     f_deps, _ = _block_io(fb, parent)
     deps = deps + [n for n in f_deps if n not in deps]

@@ -24,7 +24,11 @@ import jax.numpy as jnp
 
 from .registry import ExecContext, register_op
 
-_INTERNAL_KEYS = ("__axis_env__", "__rng_key")
+# Only the RNG key is stripped (it is re-threaded explicitly via the carry);
+# __axis_env__ MUST propagate so collectives inside a sub-block (allreduce in
+# a StaticRNN body under shard_map, ring_attention in a while, ...) still
+# resolve their mesh axis instead of silently lowering to local compute.
+_INTERNAL_KEYS = ("__rng_key",)
 
 
 def _outer_env(ctx: ExecContext) -> dict:

@@ -61,6 +61,33 @@ def test_cond_selects_branch():
     np.testing.assert_allclose(got_f, -xv)
 
 
+def test_cond_branch_may_return_outer_var_directly():
+    """A branch fn that returns an outer-scope var (zero ops in the branch
+    before the bridge assign) must still wire that var into Deps."""
+    x = L.data(name="x", shape=[1], dtype="float32")
+    yv = L.fc(x, size=1)
+    p = L.fill_constant([1], "bool", False)
+    out = L.cond(p, lambda: L.scale(x, 2.0), lambda: yv)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.ones((2, 1), np.float32)
+    (got,) = exe.run(pt.default_main_program(), feed={"x": xv}, fetch_list=[out])
+    (ref,) = exe.run(pt.default_main_program(), feed={"x": xv}, fetch_list=[yv])
+    np.testing.assert_allclose(got, ref)
+
+
+def test_cond_outer_scope_write_raises():
+    """ADVICE r1: a branch assigning to an outer-scope var would be silently
+    discarded under functional tracing — must raise instead."""
+    x = L.data(name="x", shape=[1], dtype="float32")
+    a = L.scale(x, 1.0)
+    p = L.fill_constant([1], "bool", True)
+    with pytest.raises(ValueError, match="outer-scope"):
+        L.cond(p,
+               lambda: L.assign(L.scale(x, 2.0), a),
+               lambda: a)
+
+
 def test_static_rnn_forward_matches_numpy():
     T_, B, D, H = 5, 2, 3, 4
     x = L.data(name="x", shape=[B, D], dtype="float32")  # time-major [T,B,D]

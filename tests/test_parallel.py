@@ -163,6 +163,42 @@ def test_collective_ops_shard_map_semantics():
     np.testing.assert_allclose(np.asarray(out).reshape(()), xv.sum(), rtol=1e-6)
 
 
+def test_allreduce_inside_static_rnn_body():
+    """ADVICE r1 (medium): __axis_env__ must propagate into control-flow
+    sub-blocks — a c_allreduce_sum inside a StaticRNN body under
+    with_collective must really sum across the dp axis, not lower to
+    identity/local compute."""
+    from paddle_tpu.layers.control_flow import StaticRNN
+
+    mesh = make_mesh({"dp": 8})
+    T = 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data(name="x", shape=[T], dtype="float32")  # [B, T]
+        xt = L.transpose(x, perm=[1, 0])  # [T, B_local]
+        h0 = L.fill_constant(shape=[1], dtype="float32", value=0.0)
+        rnn = StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(xt)  # [B_local]
+            prev = rnn.memory(init=h0)  # [1]
+            s = L.reduce_sum(word, keep_dim=True)  # local partial sum, [1]
+            blk = main.current_block()
+            blk.append_op(
+                "c_allreduce_sum", {"X": [s.name]}, {"Out": [s.name]}, {"ring_id": 0}
+            )
+            h = L.elementwise_add(prev, s)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    exe = pt.Executor()
+    xv = np.arange(32, dtype=np.float32).reshape(8, T)
+    compiled = pt.CompiledProgram(main).with_collective(mesh=mesh)
+    (hist,) = exe.run(compiled, feed={"x": xv}, fetch_list=[out.name])
+    hist = np.asarray(hist)  # [T, 1] running sums of global per-step sums
+    np.testing.assert_allclose(hist[-1].reshape(()), xv.sum(), rtol=1e-6)
+    np.testing.assert_allclose(hist[0].reshape(()), xv[:, 0].sum(), rtol=1e-6)
+
+
 def test_tp_sharding_annotation_compiles():
     """Megatron-style TP: shard fc weights over 'tp'; program must compile and
     match the unsharded result."""
