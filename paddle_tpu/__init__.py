@@ -6,8 +6,10 @@ and distribution as program transformations, Executor.run(feed, fetch) — with
 a new execution model: whole-block lowering to XLA via JAX, SPMD parallelism
 over jax.sharding meshes, and Pallas kernels for hot ops.
 """
+from . import flags  # noqa: F401  (first: other modules read flags at import)
 from . import core  # noqa: F401
 from . import ops  # noqa: F401
+from . import profiler  # noqa: F401
 from . import layers  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
@@ -30,6 +32,7 @@ from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa:
 from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .framework import (  # noqa: F401
     Block,
+    OpError,
     Operator,
     Parameter,
     Program,

@@ -31,12 +31,44 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .framework import Program, Variable, default_main_program
+from . import flags
+from .framework import OpError, Program, Variable, default_main_program
 from .ops.registry import ExecContext, get_op_def
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard"]
 
 _SKIP_OPS = ("feed", "fetch")
+
+
+def _compute_op(opdef, ctx, op):
+    """Run one op's compute with creation-stack attribution on failure."""
+    try:
+        return opdef.compute(ctx)
+    except OpError:
+        raise
+    except Exception as e:
+        raise OpError(op, e) from e
+
+
+def _maybe_check_finite(op, outs):
+    """FLAGS_check_nan_inf debug mode (reference operator.cc:949): under
+    jax.disable_jit() values are concrete, so validate every floating output;
+    tracers (normal jitted path) are skipped."""
+    if not flags.get_flag("check_nan_inf"):
+        return
+    for slot, val in outs.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        for v in vals:
+            if v is None or isinstance(v, jax.core.Tracer):
+                continue
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                raise OpError(
+                    op,
+                    FloatingPointError(
+                        f"output slot '{slot}' contains nan/inf "
+                        f"(FLAGS_check_nan_inf)"),
+                )
 
 
 class Scope:
@@ -167,7 +199,8 @@ def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env
                 env["__rng_key"] = key_new
                 rng = sub
             ctx = ExecContext(op, env, rng=rng, lowerer=lowerer)
-            outs = opdef.compute(ctx)
+            outs = _compute_op(opdef, ctx, op)
+            _maybe_check_finite(op, outs)
             for slot, val in outs.items():
                 names = op.outputs.get(slot, [])
                 vals = val if isinstance(val, (list, tuple)) else [val]
@@ -203,7 +236,8 @@ def _run_ops_traced(block, env, key=None):
             key, rng = jax.random.split(key)
         env["__rng_key"] = key
         ctx = ExecContext(op, env, rng=rng, lowerer=lowerer)
-        outs = opdef.compute(ctx)
+        outs = _compute_op(opdef, ctx, op)
+        _maybe_check_finite(op, outs)
         for slot, val in outs.items():
             names = op.outputs.get(slot, [])
             vals = val if isinstance(val, (list, tuple)) else [val]
@@ -278,7 +312,17 @@ class Executor:
         key = jax.random.PRNGKey(program.random_seed or 0)
         key = jax.random.fold_in(key, scope._run_counter)
 
-        fetches, new_rw, new_extra = comp.fn(tuple(feed_vals), ro_vals, rw_vals, key)
+        if flags.get_flag("check_nan_inf"):
+            # debug mode: run the whole block eagerly so per-op outputs are
+            # concrete and _maybe_check_finite fires with op attribution
+            with jax.disable_jit():
+                fetches, new_rw, new_extra = comp.fn(
+                    tuple(feed_vals), ro_vals, rw_vals, key)
+        else:
+            fetches, new_rw, new_extra = comp.fn(
+                tuple(feed_vals), ro_vals, rw_vals, key)
+        if flags.get_flag("benchmark"):
+            jax.block_until_ready((fetches, new_rw))  # reference operator.cc:926
 
         for n, v in zip(comp.rw_names, new_rw):
             scope.set_var(n, v)

@@ -1,0 +1,70 @@
+"""Global flags registry.
+
+TPU-native equivalent of the reference's gflags hub
+(/root/reference/paddle/fluid/platform/flags.cc) + the Python env bootstrap
+(/root/reference/python/paddle/fluid/__init__.py:152 read_env_flags): flags are
+declared here with defaults, overridden from the environment (`FLAGS_<name>`)
+at import, and adjustable at runtime via `set_flags`.
+
+Only flags that DO something on this runtime are declared; CUDA/allocator
+knobs from the reference are subsumed by XLA and intentionally absent.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_FLAGS: dict[str, Any] = {}
+_DEFS: dict[str, tuple[type, str]] = {}
+
+
+def _define(name: str, default, help: str):
+    ftype = type(default)
+    _DEFS[name] = (ftype, help)
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        _FLAGS[name] = _parse(ftype, env)
+    else:
+        _FLAGS[name] = default
+
+
+def _parse(ftype, text: str):
+    if ftype is bool:
+        return text.strip().lower() in ("1", "true", "yes", "on")
+    return ftype(text)
+
+
+def get_flag(name: str):
+    if name not in _FLAGS:
+        raise KeyError(f"unknown flag '{name}'; known: {sorted(_FLAGS)}")
+    return _FLAGS[name]
+
+
+def set_flags(flags: dict):
+    """Runtime override (reference fluid.core.init_gflags analogue)."""
+    for k, v in flags.items():
+        k = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if k not in _DEFS:
+            raise KeyError(f"unknown flag '{k}'; known: {sorted(_DEFS)}")
+        _FLAGS[k] = _parse(_DEFS[k][0], str(v)) if not isinstance(v, _DEFS[k][0]) else v
+
+
+def all_flags() -> dict:
+    return dict(_FLAGS)
+
+
+# -- declarations ------------------------------------------------------------
+_define("check_nan_inf", False,
+        "run eagerly and validate every op's floating outputs are finite, "
+        "raising with op attribution (reference operator.cc:949)")
+_define("op_callstack", True,
+        "capture the Python creation stack of every Operator for error "
+        "attribution (reference framework/op_call_stack.cc)")
+_define("benchmark", False,
+        "block on the device after every Executor.run for timing-accurate "
+        "debugging (reference operator.cc:926)")
+_define("cpu_deterministic", False,
+        "request deterministic XLA reductions (maps to XLA determinism; "
+        "reference flags.cc:98)")
+_define("profiler_dir", "/tmp/paddle_tpu_profile",
+        "default trace output directory for profiler.profiler()")

@@ -57,6 +57,32 @@ def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
 
 
+_PKG_DIR = __file__.rsplit("/", 1)[0] + "/"
+
+
+def _capture_callstack():
+    """Trimmed user-code creation stack for one Operator (reference
+    framework/op_call_stack.cc attaches this to runtime errors). Frames inside
+    paddle_tpu itself are dropped so the stack points at the line of *user*
+    code that built the op; capped at 8 frames. Disable via
+    FLAGS_op_callstack=0 (costs ~10us/op at build time)."""
+    from . import flags
+
+    if not flags.get_flag("op_callstack"):
+        return None
+    import traceback
+
+    frames = []
+    for f, ln, fn, txt in traceback.extract_stack()[:-2]:
+        if f.startswith(_PKG_DIR):
+            continue
+        frames.append((f, ln, fn, txt))
+    return frames[-8:]
+
+
+_name_scope_stack: list[str] = []
+
+
 class Variable:
     """A named, typed, statically-shaped value in a Block.
 
@@ -196,6 +222,21 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        # diagnostics (filled by Block.append_op — NOT here, so clone() and
+        # from_dict() don't overwrite stacks or pick up foreign name scopes):
+        # Python creation stack (reference op_call_stack.cc) + recorded
+        # shape-inference failure, attached to later runtime errors
+        self._callstack: list | None = None
+        self._infer_error: str | None = None
+
+    def callstack_str(self) -> str:
+        """Render the creation stack (user frames) for error messages."""
+        if not self._callstack:
+            return "  <op creation stack not captured; FLAGS_op_callstack=0>"
+        return "".join(
+            f"  File \"{f}\", line {ln}, in {fn}\n    {txt}\n"
+            for f, ln, fn, txt in self._callstack
+        ).rstrip("\n")
 
     def input(self, slot: str) -> list[str]:
         return self.inputs.get(slot, [])
@@ -235,6 +276,28 @@ class Operator:
         ins = {k: v for k, v in self.inputs.items()}
         outs = {k: v for k, v in self.outputs.items()}
         return f"Op({self.type}, in={ins}, out={outs})"
+
+
+class OpError(RuntimeError):
+    """An op failed to lower/execute; carries the op's Python creation stack
+    (the reference's EnforceNotMet + op_call_stack.cc attribution)."""
+
+    def __init__(self, op: "Operator", cause: BaseException):
+        self.op = op
+        self.cause = cause
+        scope = op.attrs.get("op_namescope")
+        parts = [
+            f"Operator '{op.type}'" + (f" (scope {scope})" if scope else "")
+            + f" failed: {type(cause).__name__}: {cause}",
+            f"  op: {op!r}",
+        ]
+        if op._infer_error is not None:
+            parts.append(
+                f"  note: shape inference had already failed at build time "
+                f"with: {op._infer_error}")
+        parts.append("Op creation stack (most recent call last):")
+        parts.append(op.callstack_str())
+        super().__init__("\n".join(parts))
 
 
 class Block:
@@ -291,6 +354,9 @@ class Block:
     # -- op management ------------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type, inputs, outputs, attrs)
+        op._callstack = _capture_callstack()
+        if _name_scope_stack:
+            op.attrs.setdefault("op_namescope", "/".join(_name_scope_stack))
         self.ops.append(op)
         for name in op.output_names:
             if name in self.vars:
@@ -304,6 +370,9 @@ class Block:
 
     def _insert_op(self, index: int, type: str, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        op._callstack = _capture_callstack()
+        if _name_scope_stack:
+            op.attrs.setdefault("op_namescope", "/".join(_name_scope_stack))
         self.ops.insert(index, op)
         self.program._bump_version()
         from .ops.registry import infer_op
@@ -382,6 +451,8 @@ class Program:
                 nb.vars[name] = nv
             for op in blk.ops:
                 nop = Operator(nb, op.type, op.inputs, op.outputs, copy.deepcopy(op.attrs))
+                nop._callstack = op._callstack  # keep original creation site
+                nop._infer_error = op._infer_error
                 if for_test and "is_test" in nop.attrs:
                     nop.attrs["is_test"] = True
                 if for_test and nop.type == "dropout":
@@ -474,7 +545,12 @@ def program_guard(main_program: Program, startup_program: Program | None = None)
 
 @contextlib.contextmanager
 def name_scope(prefix: str):
-    """Cosmetic name scoping (reference framework.py name_scope). Purely
-    cosmetic like the reference — it must NOT reset the unique-name counters,
-    or re-entering the same scope would collide parameter names."""
-    yield
+    """Debug/profiling name scoping (reference framework.py name_scope): ops
+    appended inside carry an `op_namescope` attr ("outer/inner"), visible in
+    serialized programs and error messages. It must NOT reset the unique-name
+    counters, or re-entering the same scope would collide parameter names."""
+    _name_scope_stack.append(str(prefix))
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()

@@ -329,8 +329,16 @@ def infer_op(op, block) -> None:
 
     try:
         out = jax.eval_shape(f, tuple(env.values()), rng)
-    except Exception:
-        return  # inference is best-effort; executor will catch real errors
+    except Exception as e:
+        # Record instead of swallowing (reference op_call_stack.cc invests in
+        # exactly this attribution path): some ops legitimately fail dry-run
+        # inference (control flow needs the lowerer, collectives need the
+        # mesh-axis env), so this is not fatal here — but if the op later
+        # fails at trace time, the executor surfaces this recorded error
+        # alongside the op's Python creation stack. Stored as a string so the
+        # exception's frames aren't pinned for the Program's lifetime.
+        op._infer_error = f"{type(e).__name__}: {e}"
+        return
     _write_inferred(op, block, out)
 
 

@@ -1,0 +1,73 @@
+"""Profiler front-end over jax.profiler (XPlane/Xprof traces).
+
+TPU-native replacement for the reference's profiler stack:
+  * python context manager `profiler` — reference fluid/profiler.py:225
+  * RecordEvent host spans — reference platform/profiler.h:81
+  * CUPTI device tracer -> here the XLA runtime's own trace collection
+    (/root/reference/paddle/fluid/platform/device_tracer.cc:272); the output
+    is an XPlane protobuf directory loadable in TensorBoard/Xprof instead of
+    the reference's chrome://tracing JSON (tools/timeline.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+from . import flags
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
+           "record_event"]
+
+
+def _resolve_dir(path: str | None) -> str:
+    return path or flags.get_flag("profiler_dir")
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str | None = None,
+             profile_path: str | None = None):
+    """`with profiler.profiler(...):` traces everything inside to an XPlane
+    directory. `state`/`sorted_key` are accepted for reference API parity
+    (fluid/profiler.py:225); on TPU the trace always covers host + device and
+    sorting happens in the viewer."""
+    path = _resolve_dir(profile_path)
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield
+
+
+def start_profiler(state: str = "All", profile_path: str | None = None):
+    """Imperative start (reference fluid/profiler.py start_profiler)."""
+    path = _resolve_dir(profile_path)
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+
+
+def stop_profiler(sorted_key: str | None = None, profile_path: str | None = None):
+    """Stop the active trace. Both args are reference-API-parity no-ops: the
+    trace lands in the directory given to start_profiler, and sorting happens
+    in the viewer."""
+    jax.profiler.stop_trace()
+
+
+class RecordEvent(contextlib.ContextDecorator):
+    """Named host span visible in the trace (reference platform/profiler.h:81
+    RAII RecordEvent). Usable as a context manager or decorator."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._ann = None
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self._name)
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        ann, self._ann = self._ann, None
+        return ann.__exit__(*a)
+
+
+record_event = RecordEvent

@@ -1,0 +1,96 @@
+"""Diagnostics: op creation-stack attribution, check_nan_inf debug mode,
+flags registry, profiler traces, name_scope.
+
+Reference analogues: op_call_stack.cc + enforce.h (attribution),
+operator.cc:949 FLAGS_check_nan_inf, platform/flags.cc + read_env_flags,
+fluid/profiler.py:225.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu import layers as L
+
+
+def test_failing_op_error_names_creation_site():
+    """A trace-time failure must name the op and the user line that built it."""
+    x = L.data(name="x", shape=[4], dtype="float32")
+    y = L.data(name="y", shape=[7], dtype="float32")
+    blk = pt.default_main_program().current_block()
+    out = blk.create_var(name="bad_out", shape=[4], dtype="float32")
+    # bypass the layer API's shape checking: matmul on incompatible shapes
+    blk.append_op("matmul", {"X": [x.name], "Y": [y.name]}, {"Out": [out.name]})
+    MARKER_LINE = "bad_matmul_marker"  # noqa: F841 (appears in the stack text)
+    exe = pt.Executor()
+    with pytest.raises(pt.OpError) as ei:
+        exe.run(feed={"x": np.ones((2, 4), np.float32),
+                      "y": np.ones((2, 7), np.float32)},
+                fetch_list=[out])
+    msg = str(ei.value)
+    assert "Operator 'matmul'" in msg
+    assert "test_diagnostics.py" in msg  # creation stack points at user code
+    assert "append_op" in msg
+
+
+def test_infer_error_is_recorded_not_swallowed():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    blk = pt.default_main_program().current_block()
+    out = blk.create_var(name="o", shape=[4], dtype="float32")
+    op = blk.append_op("matmul", {"X": [x.name], "Y": [x.name]}, {"Out": [out.name]})
+    assert op._infer_error is not None  # [B,4]x[B,4] doesn't contract
+
+
+def test_check_nan_inf_names_offending_op():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    z = L.scale(x, scale=0.0)
+    bad = L.elementwise_div(x, z)  # div by zero -> inf
+    out = L.mean(bad)
+    exe = pt.Executor()
+    flags.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(pt.OpError) as ei:
+            exe.run(feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[out])
+        assert "elementwise_div" in str(ei.value)
+        assert "nan/inf" in str(ei.value)
+    finally:
+        flags.set_flags({"check_nan_inf": False})
+
+
+def test_flags_env_and_set():
+    assert flags.get_flag("op_callstack") is True
+    flags.set_flags({"FLAGS_benchmark": "1"})
+    assert flags.get_flag("benchmark") is True
+    flags.set_flags({"benchmark": False})
+    assert flags.get_flag("benchmark") is False
+    with pytest.raises(KeyError):
+        flags.get_flag("no_such_flag")
+    with pytest.raises(KeyError):
+        flags.set_flags({"no_such_flag": 1})
+
+
+def test_profiler_emits_trace_dir(tmp_path):
+    from paddle_tpu import profiler
+
+    x = L.data(name="x", shape=[4], dtype="float32")
+    out = L.mean(L.scale(x, 2.0))
+    exe = pt.Executor()
+    d = str(tmp_path / "trace")
+    with profiler.profiler(profile_path=d):
+        with profiler.RecordEvent("step"):
+            exe.run(feed={"x": np.ones((2, 4), np.float32)}, fetch_list=[out])
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs
+    ]
+    assert found, "profiler produced no trace files"
+
+
+def test_name_scope_tags_ops():
+    x = L.data(name="x", shape=[4], dtype="float32")
+    with pt.name_scope("encoder"):
+        with pt.name_scope("block1"):
+            h = L.scale(x, 2.0)
+    op = pt.default_main_program().current_block().ops[-1]
+    assert op.attrs["op_namescope"] == "encoder/block1"
