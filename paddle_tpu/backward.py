@@ -16,13 +16,13 @@ from .ops.registry import default_grad_maker, get_op_def
 __all__ = ["append_backward", "gradients"]
 
 
-def _find_op_path(block, loss_name: str) -> list[int]:
-    """Indices of ops that (transitively) produce `loss_name` from data/params.
+def _find_op_path(block, target_names) -> list[int]:
+    """Indices of ops that (transitively) produce any target from data/params.
 
     Mirrors the reference's _find_op_path_ (backward.py:780): a backward sweep
     collecting ops whose outputs are needed.
     """
-    needed = {loss_name}
+    needed = set(target_names) if not isinstance(target_names, str) else {target_names}
     path = []
     for i in range(len(block.ops) - 1, -1, -1):
         op = block.ops[i]
@@ -64,13 +64,31 @@ def append_backward(
     )
 
     # 2. reverse sweep, with repeated-grad accumulation
-    available_grads = {loss_grad}
+    available_grads = _backward_sweep(block, op_path, {loss_grad}, no_grad)
+
+    # 3. collect (param, grad) pairs
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if getattr(p, "trainable", True)]
+    result = []
+    for p in params:
+        g = grad_var_name(p.name)
+        if g in available_grads:
+            result.append((p, block.var(g)))
+    return result
+
+
+def _backward_sweep(block, op_path, seed_grads: set, no_grad: set) -> set:
+    """Reverse sweep over `op_path` emitting grad ops; returns all grad var
+    names made available. `seed_grads` are pre-seeded cotangent var names."""
+    available_grads = set(seed_grads)
     pending_sum: dict[str, list[str]] = {}  # fwd var -> partial grad var names
 
     ops_snapshot = [block.ops[i] for i in op_path]
     for op in reversed(ops_snapshot):
         opdef = get_op_def(op.type) if _has(op.type) else None
-        if not any(grad_var_name(n) in available_grads or n == loss.name for n in op.output_names):
+        if not any(grad_var_name(n) in available_grads for n in op.output_names):
             # no grad flows into this op's outputs
             continue
         if opdef is None or opdef.no_grad:
@@ -80,7 +98,7 @@ def append_backward(
             # fill_constant are harmless).
             if _has_differentiable_inputs(op, block, no_grad):
                 raise RuntimeError(
-                    f"op '{op.type}' lies on the gradient path to '{loss.name}'"
+                    f"op '{op.type}' lies on the gradient path"
                     f" but has no gradient (forward-only). Parameters upstream "
                     f"of it would silently stop training. Use a differentiable "
                     f"alternative (e.g. static_rnn instead of while), or mark "
@@ -125,18 +143,7 @@ def append_backward(
                         outputs={"Out": [orig]},
                     )
                     pending_sum[orig] = [orig]
-        # make this op's input-grads visible
-    # 3. collect (param, grad) pairs
-    if parameter_list is not None:
-        params = [block.var(p) if isinstance(p, str) else p for p in parameter_list]
-    else:
-        params = [p for p in program.all_parameters() if getattr(p, "trainable", True)]
-    result = []
-    for p in params:
-        g = grad_var_name(p.name)
-        if g in available_grads:
-            result.append((p, block.var(g)))
-    return result
+    return available_grads
 
 
 def _has(t):
@@ -163,23 +170,67 @@ def _has_differentiable_inputs(op, block, no_grad: set) -> bool:
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """Compute grads of targets w.r.t. inputs (reference backward.py:938)."""
-    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
-    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    if target_gradients is not None:
-        raise NotImplementedError(
-            "gradients(target_gradients=...) is not supported yet; seed "
-            "cotangents by scaling the target before calling gradients()."
-        )
-    if len(tgts) > 1:
-        raise NotImplementedError(
-            "gradients() over multiple targets is not supported yet; sum the "
-            "targets into one scalar first."
-        )
-    append_backward(tgts[0], parameter_list=None, no_grad_set=no_grad_set)
-    block = tgts[0].block.program.global_block
+    """Compute grads of targets w.r.t. inputs (reference backward.py:938
+    calc_gradient): supports multiple targets and per-target seed cotangents.
+    A missing/None target_gradient seeds with ones (matching the reference)."""
+    tgts = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    tgs = (list(target_gradients)
+           if isinstance(target_gradients, (list, tuple))
+           else [target_gradients] * len(tgts))
+    if len(tgs) != len(tgts):
+        raise ValueError(
+            f"target_gradients has {len(tgs)} entries for {len(tgts)} targets")
+
+    program: Program = tgts[0].block.program
+    block = program.global_block
+    # asking for d(target)/d(input) implies the input is differentiable, even
+    # for data vars (which default to stop_gradient=True); restored after the
+    # sweep so later append_backward calls on this program are unaffected
+    saved_sg = [(v, v.stop_gradient) for v in ins]
+    for v in ins:
+        v.stop_gradient = False
+    try:
+        return _calc_gradients(block, tgts, ins, tgs, no_grad_set)
+    finally:
+        for v, sg in saved_sg:
+            v.stop_gradient = sg
+
+
+def _calc_gradients(block, tgts, ins, tgs, no_grad_set):
+    no_grad = set(no_grad_set or ())
+    for v in block.vars.values():
+        if v.stop_gradient and not v.persistable:
+            no_grad.add(v.name)
+
+    op_path = _find_op_path(block, {t.name for t in tgts})
+
+    seeds = set()
+    for t, tg in zip(tgts, tgs):
+        g = grad_var_name(t.name)
+        block.create_var(name=g, shape=t.shape, dtype=t.dtype)
+        if tg is None:
+            # fill_any_like handles batch-polymorphic (-1) target shapes
+            block.append_op(
+                "fill_any_like",
+                inputs={"X": [t.name]},
+                outputs={"Out": [g]},
+                attrs={"value": 1.0},
+            )
+        else:
+            if len(tg.shape) != len(t.shape) or any(
+                td not in (-1, gd) and gd != -1
+                for td, gd in zip(t.shape, tg.shape)
+            ):
+                raise ValueError(
+                    f"target_gradient for '{t.name}' has shape "
+                    f"{tuple(tg.shape)}, expected {tuple(t.shape)}")
+            block.append_op("assign", {"X": [tg.name]}, {"Out": [g]}, {})
+        seeds.add(g)
+
+    available = _backward_sweep(block, op_path, seeds, no_grad)
     out = []
     for v in ins:
         g = grad_var_name(v.name)
-        out.append(block.var(g) if block.has_var(g) else None)
+        out.append(block.var(g) if g in available and block.has_var(g) else None)
     return out

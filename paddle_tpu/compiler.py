@@ -36,7 +36,10 @@ class BuildStrategy:
         self.num_trainers = 1
         self.trainer_id = 0
         self.use_hierarchical_allreduce = False
-        self.sharded_optimizer_states = False  # ZeRO-ish: shard opt state over dp axis
+        # ZeRO-1 style: store optimizer accumulators sharded over the dp axis.
+        # XLA computes the param update on each dp shard and all-gathers the
+        # result into the replicated param — opt-state HBM drops by |dp|.
+        self.sharded_optimizer_states = False
 
 
 class ExecutionStrategy:
@@ -77,7 +80,26 @@ class CompiledProgram:
             self._build_strategy = build_strategy
         self._mesh = mesh if mesh is not None else make_mesh(places=places)
         self._spmd_mode = "gspmd"
+        if self._build_strategy.sharded_optimizer_states:
+            self._annotate_opt_state_shardings()
         return self
+
+    def _annotate_opt_state_shardings(self):
+        """ZeRO-1: shard optimizer accumulators (tagged by
+        Optimizer._add_accumulator) over the dp axis on their leading dim when
+        it divides evenly. Reuses the ordinary GSPMD annotation machinery —
+        the reference's ReduceSSAGraphBuilder 'balance optimizer compute'
+        strategy (multi_devices_graph_pass.h:157) done the TPU way."""
+        from .parallel.mesh import DATA_AXIS
+
+        if DATA_AXIS not in self._mesh.axis_names:
+            return
+        dp = self._mesh.shape[DATA_AXIS]
+        for v in self._program.global_block.vars.values():
+            if (getattr(v, "is_opt_state", False) and v.sharding is None
+                    and len(v.shape) >= 1 and v.shape[0] % dp == 0
+                    and v.shape[0] >= dp):
+                v.sharding = (DATA_AXIS,) + (None,) * (len(v.shape) - 1)
 
     def with_collective(self, mesh=None, places=None) -> "CompiledProgram":
         """Execute under shard_map with mesh axes bound, so transpiler-inserted

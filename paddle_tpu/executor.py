@@ -71,10 +71,16 @@ def _maybe_check_finite(op, outs):
                 )
 
 
+_scope_uid = 0
+
+
 class Scope:
     """Flat name -> device array store (reference framework/scope.h:46)."""
 
     def __init__(self):
+        global _scope_uid
+        _scope_uid += 1
+        self._uid = _scope_uid  # stable identity for compile-cache keys
         self._vars: dict[str, Any] = {}
         self._run_counter = 0
 
@@ -290,13 +296,23 @@ class Executor:
                 pass
             feed_vals.append(v)
 
+        # stable keys: Scope carries a uid (id() of a dead object can be
+        # reused, silently aliasing cache entries); a mesh is keyed by its
+        # layout, so two equal meshes share a compile
+        mesh_key = None
+        if mesh is not None:
+            mesh_key = (
+                tuple(mesh.axis_names),
+                tuple(mesh.devices.shape),
+                tuple(d.id for d in mesh.devices.flat),
+            )
         sig = (
             program._version,
             tuple((n, fv.shape, str(fv.dtype)) for n, fv in zip(feed_names, feed_vals)),
             tuple(fetch_names),
-            id(mesh) if mesh is not None else None,
+            mesh_key,
             spmd_mode,
-            id(scope),  # extra_w write-back analysis depends on scope contents
+            scope._uid,  # extra_w write-back analysis depends on scope contents
         )
         prog_cache = self._cache.setdefault(program, {})
         comp = prog_cache.get(sig)
@@ -304,7 +320,14 @@ class Executor:
             comp = self._compile(
                 program, block, feed_names, feed_vals, fetch_names, scope, mesh, spmd_mode
             )
+            comp.spmd_mode = spmd_mode
             prog_cache[sig] = comp
+            # bound the per-program cache (each entry pins a compiled XLA
+            # executable); evict least-recently-used beyond 64 signatures
+            while len(prog_cache) > 64:
+                prog_cache.pop(next(iter(prog_cache)))
+        else:
+            prog_cache[sig] = prog_cache.pop(sig)  # LRU refresh
 
         ro_vals = tuple(self._fetch_state(scope, n) for n in comp.ro_names)
         rw_vals = tuple(self._fetch_state(scope, n) for n in comp.rw_names)
@@ -314,10 +337,23 @@ class Executor:
 
         if flags.get_flag("check_nan_inf"):
             # debug mode: run the whole block eagerly so per-op outputs are
-            # concrete and _maybe_check_finite fires with op attribution
+            # concrete and _maybe_check_finite fires with op attribution.
+            # Under shard_map the body values stay tracers even with
+            # disable_jit, so per-op attribution is unavailable — fall back to
+            # a whole-step output check below.
             with jax.disable_jit():
                 fetches, new_rw, new_extra = comp.fn(
                     tuple(feed_vals), ro_vals, rw_vals, key)
+            if getattr(comp, "spmd_mode", "gspmd") == "shard_map":
+                for group, names in ((fetches, comp.fetch_names),
+                                     (new_rw, comp.rw_names)):
+                    for n, v in zip(names, group):
+                        arr = np.asarray(v)
+                        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                            raise RuntimeError(
+                                f"FLAGS_check_nan_inf: non-finite value in "
+                                f"'{n}' (per-op attribution is unavailable "
+                                f"under shard_map/with_collective)")
         else:
             fetches, new_rw, new_extra = comp.fn(
                 tuple(feed_vals), ro_vals, rw_vals, key)

@@ -125,7 +125,7 @@ class Variable:
         return len(self.shape)
 
     def to_dict(self):
-        return {
+        d = {
             "name": self.name,
             "shape": list(self.shape),
             "dtype": self.dtype.value,
@@ -136,6 +136,9 @@ class Variable:
             "sharding": list(self.sharding) if self.sharding else None,
             "is_parameter": isinstance(self, Parameter),
         }
+        if getattr(self, "is_opt_state", False):
+            d["is_opt_state"] = True  # ZeRO tag must survive serialization
+        return d
 
     def __repr__(self):
         return (
@@ -492,6 +495,8 @@ class Program:
                 else:
                     v = Variable(b, shape=vd["shape"], dtype=vd["dtype"],
                                  persistable=vd["persistable"], **common)
+                if vd.get("is_opt_state"):
+                    v.is_opt_state = True
                 b.vars[name] = v
             for od in bd["ops"]:
                 b.ops.append(Operator(b, od["type"], od["inputs"], od["outputs"], od["attrs"]))

@@ -103,6 +103,9 @@ class Optimizer:
             dtype,
             initializer=Constant(fill_value),
         )
+        # tag for ZeRO-style sharding (BuildStrategy.sharded_optimizer_states):
+        # the compiler may shard these over the dp axis
+        var.is_opt_state = True
         self._accumulators.setdefault(name, {})[param.name] = var
         return var
 

@@ -58,16 +58,16 @@ class RecordEvent(contextlib.ContextDecorator):
 
     def __init__(self, name: str):
         self._name = name
-        self._ann = None
+        self._anns: list = []  # stack: one instance may nest/recurse
 
     def __enter__(self):
-        self._ann = jax.profiler.TraceAnnotation(self._name)
-        self._ann.__enter__()
+        ann = jax.profiler.TraceAnnotation(self._name)
+        ann.__enter__()
+        self._anns.append(ann)
         return self
 
     def __exit__(self, *a):
-        ann, self._ann = self._ann, None
-        return ann.__exit__(*a)
+        return self._anns.pop().__exit__(*a)
 
 
 record_event = RecordEvent

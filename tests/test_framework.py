@@ -1,6 +1,7 @@
 """Program/Block/Operator IR unit tests (reference test pattern:
 python/paddle/fluid/tests/unittests/test_program.py, test_operator_desc.py)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers as L
@@ -88,6 +89,41 @@ def test_stop_gradient_blocks_backward():
     names = [p.name for p, _ in pgs]
     # first fc's weight gets no grad because h1 blocks the path
     assert len(pgs) == 1
+
+
+def test_gradients_multi_target_with_seed_cotangents():
+    """gradients() over two targets with explicit target_gradients must match
+    the analytic d(w1*t1 + w2*t2)/dx (reference calc_gradient backward.py:820)."""
+    x = L.data(name="x", shape=[4], dtype="float32")
+    t1 = L.scale(x, 2.0)   # dt1/dx = 2
+    t2 = L.scale(x, -3.0)  # dt2/dx = -3
+    w1 = L.fill_constant([2, 4], "float32", 0.5)
+    w2 = L.fill_constant([2, 4], "float32", 1.0)
+    (gx,) = pt.gradients([t1, t2], [x], target_gradients=[w1, w2])
+    assert gx is not None
+    exe = pt.Executor()
+    xv = np.ones((2, 4), np.float32)
+    (g,) = exe.run(pt.default_main_program(), feed={"x": xv}, fetch_list=[gx])
+    # dx = 2*0.5 + (-3)*1.0 = -2
+    np.testing.assert_allclose(g, np.full((2, 4), -2.0, np.float32), rtol=1e-6)
+
+
+def test_gradients_default_seed_is_ones():
+    x = L.data(name="x", shape=[3], dtype="float32")
+    t = L.scale(x, 4.0)
+    (gx,) = pt.gradients(t, [x])
+    exe = pt.Executor()
+    (g,) = exe.run(pt.default_main_program(),
+                   feed={"x": np.ones((2, 3), np.float32)}, fetch_list=[gx])
+    np.testing.assert_allclose(g, np.full((2, 3), 4.0, np.float32), rtol=1e-6)
+
+
+def test_gradients_target_gradient_shape_mismatch_raises():
+    x = L.data(name="x", shape=[3], dtype="float32")
+    t = L.scale(x, 4.0)
+    bad = L.fill_constant([5], "float32", 1.0)
+    with pytest.raises(ValueError, match="shape"):
+        pt.gradients(t, [x], target_gradients=[bad])
 
 
 def test_executor_compile_cache_batch_polymorphism():
