@@ -284,16 +284,30 @@ class Executor:
         scope = scope or global_scope()
         fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in (fetch_list or [])]
 
+        if getattr(program, "_pipeline", None) is not None:
+            # pipeline-optimized program: delegate the whole GPipe microbatch
+            # schedule (parallel/pipeline.py)
+            if mesh is not None:
+                raise NotImplementedError(
+                    "combining PipelineOptimizer with a CompiledProgram mesh "
+                    "is not supported yet — run the pipeline program "
+                    "directly (dp-sharding inside stages is planned)")
+            return program._pipeline.run_step(self, scope, feed, fetch_names)
+
         block = program.global_block
         feed_names = sorted(feed)
         feed_vals = []
         for n in feed_names:
-            v = np.asarray(feed[n])
-            try:
-                var = block.var(n)
-                v = v.astype(var.np_dtype, copy=False)
-            except KeyError:
-                pass
+            v = feed[n]
+            if not isinstance(v, jax.Array):
+                # host data: cast to the var's declared dtype; device arrays
+                # (e.g. pipeline stage transfers) pass through untouched
+                v = np.asarray(v)
+                try:
+                    var = block.var(n)
+                    v = v.astype(var.np_dtype, copy=False)
+                except KeyError:
+                    pass
             feed_vals.append(v)
 
         # stable keys: Scope carries a uid (id() of a dead object can be

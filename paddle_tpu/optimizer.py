@@ -23,6 +23,7 @@ from .layer_helper import LayerHelper
 from .regularizer import append_regularization_ops
 
 __all__ = [
+    "PipelineOptimizer",
     "SGD",
     "SGDOptimizer",
     "Momentum",
@@ -636,6 +637,47 @@ class ExponentialMovingAverage:
 
     def restore(self, executor=None):
         pass  # restoration handled by the apply() context manager
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel training (reference optimizer.py:2683).
+
+    Wraps an inner optimizer; `minimize` cuts the forward program at
+    `cut_list` variables into stages and attaches a GPipe microbatch plan
+    (parallel/pipeline.py). `Executor.run` on the program then executes the
+    full schedule: per-microbatch forward, rematerialized backward with
+    gradient accumulation, one inner-optimizer step.
+
+    `place_list`/`concurrency_list`/`queue_size`/`start_cpu_core_id` are
+    accepted for reference API parity; on this runtime XLA async dispatch
+    replaces section threads and scope queues.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=4):
+        self._inner_opt = optimizer
+        self._cut_list = cut_list or []
+        self._num_microbatches = num_microbatches
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .parallel.pipeline import build_pipeline_plan
+
+        if isinstance(self._inner_opt._learning_rate, Variable):
+            raise NotImplementedError(
+                "PipelineOptimizer does not support LR-scheduler Variables "
+                "yet: the scheduler ops live in the sliced forward program "
+                "and would never run for the stage update programs. Use a "
+                "float learning rate.")
+        cuts = []
+        for group in self._cut_list:
+            cuts.extend(group if isinstance(group, (list, tuple)) else [group])
+        program = loss.block.program
+        program._pipeline = build_pipeline_plan(
+            program, loss, cuts, self._inner_opt, self._num_microbatches,
+            startup_program)
+        return [], []
 
 
 # short aliases matching the reference's public names (optimizer.py:2988+)
