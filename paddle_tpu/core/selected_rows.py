@@ -1,0 +1,57 @@
+"""SelectedRows: a sparse row-set {rows, values} with a dense height.
+
+TPU-native redesign of the reference's SelectedRows
+(/root/reference/paddle/fluid/framework/selected_rows.h:32): same contract —
+`rows[i]` is the dense row index of `values[i]`, duplicates allowed (merged by
+addition) — but with STATIC shapes: `rows` has fixed length K (the number of
+lookups in the batch), so it traces through jit/XLA. Registered as a pytree,
+it flows through the executor env, `send` ops, and sparse optimizer updates
+without materializing the [height, width] dense gradient.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "is_selected_rows"]
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height: int):
+        self.rows = rows          # int32 [K]
+        self.values = values      # [K, width...]
+        self.height = int(height)  # dense dim-0 extent (static)
+
+    def to_dense(self):
+        """Scatter-add into the dense [height, ...] tensor (merges dups)."""
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def merged(self):
+        """Host-side merge of duplicate rows -> (unique_rows, summed_values).
+        For pserver-side sparse updates (numpy)."""
+        import numpy as np
+
+        rows = np.asarray(self.rows)
+        vals = np.asarray(self.values)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        out = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(out, inv, vals)
+        return uniq, out
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={getattr(self.rows, 'shape', None)}, "
+                f"values={getattr(self.values, 'shape', None)}, "
+                f"height={self.height})")
+
+
+def is_selected_rows(v) -> bool:
+    return isinstance(v, SelectedRows)
+
+
+jax.tree_util.register_pytree_node(
+    SelectedRows,
+    lambda sr: ((sr.rows, sr.values), sr.height),
+    lambda height, children: SelectedRows(children[0], children[1], height),
+)

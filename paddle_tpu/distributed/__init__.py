@@ -1,0 +1,8 @@
+"""Distributed runtime: variable RPC (pserver path) + multi-process launch.
+
+TPU-native replacement for the reference's distributed stack
+(/root/reference/paddle/fluid/operators/distributed/ gRPC/BRPC runtime,
+distributed_ops/listen_and_serv_op.cc): dense math runs on chips; the sparse/
+parameter-server path rides a host TCP variable service over DCN.
+"""
+from . import ps_rpc  # noqa: F401

@@ -1,0 +1,292 @@
+"""Parameter-server variable RPC: client + server runtime.
+
+TPU-native replacement for the reference RPC stack:
+  * `RPCClient` contract (rpc_client.h:33: AsyncSendVar :37, AsyncGetVar :43,
+    barriers :68-74) -> `PSClient` (send_var/get_var/send_barrier/
+    fetch_barrier/send_complete)
+  * `listen_and_serv` event loop (distributed_ops/listen_and_serv_op.cc) +
+    RequestSend/Get handlers (request_handler_impl.cc) -> `PServerRuntime`
+  * gRPC ByteBuffer serde (grpc/grpc_serde.cc) -> length-prefixed pickles over
+    `multiprocessing.connection` (localhost/DCN; trusted-cluster assumption,
+    authkey-protected)
+
+Sync semantics (sync_mode=True): the server buffers each trainer's gradient
+per variable; when every trainer has posted its send_barrier, gradients are
+averaged, the per-block optimize programs run once, the global step++, and
+only then are the barrier replies released — so a subsequent get_var always
+observes the post-update parameters (the reference's send_barrier/
+fetch_barrier protocol collapsed into one blocking round)."""
+from __future__ import annotations
+
+import threading
+from multiprocessing.connection import Client, Listener
+from typing import Any
+
+import numpy as np
+
+_AUTHKEY = b"paddle_tpu_ps"
+
+
+def _parse_ep(ep: str):
+    host, port = ep.rsplit(":", 1)
+    return (host, int(port))
+
+
+class PSClient:
+    """One connection per pserver endpoint; thread-safe via a lock per conn."""
+
+    _instances: dict[tuple, "PSClient"] = {}
+
+    def __init__(self, endpoints: list[str], trainer_id: int):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self._conns = {}
+        self._locks = {}
+
+    @classmethod
+    def get(cls, endpoints, trainer_id) -> "PSClient":
+        key = (tuple(endpoints), trainer_id)
+        inst = cls._instances.get(key)
+        if inst is None:
+            inst = cls._instances[key] = cls(endpoints, trainer_id)
+        return inst
+
+    def _conn(self, ep: str):
+        import time
+
+        if ep not in self._conns:
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    self._conns[ep] = Client(_parse_ep(ep), authkey=_AUTHKEY)
+                    break
+                except (ConnectionRefusedError, FileNotFoundError):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)  # server may still be starting
+            self._locks[ep] = threading.Lock()
+        return self._conns[ep], self._locks[ep]
+
+    def _call(self, ep: str, msg: dict) -> Any:
+        conn, lock = self._conn(ep)
+        with lock:
+            conn.send(msg)
+            kind, payload = conn.recv()
+        if kind == "err":
+            raise RuntimeError(f"pserver {ep}: {payload}")
+        return payload
+
+    # -- RPCClient contract --------------------------------------------------
+    def send_var(self, ep: str, name: str, value) -> None:
+        if hasattr(value, "rows"):  # SelectedRows
+            payload = ("sparse", np.asarray(value.rows),
+                       np.asarray(value.values), value.height)
+        else:
+            payload = ("dense", np.asarray(value))
+        self._call(ep, {"op": "send", "name": name,
+                        "trainer": self.trainer_id, "value": payload})
+
+    def get_var(self, ep: str, name: str) -> np.ndarray:
+        return self._call(ep, {"op": "get", "name": name})
+
+    def send_barrier(self) -> None:
+        """Blocks until the server has aggregated + applied this round."""
+        for ep in self.endpoints:
+            self._call(ep, {"op": "barrier", "trainer": self.trainer_id})
+
+    def fetch_barrier(self) -> None:
+        pass  # subsumed: send_barrier only returns post-update
+
+    def send_complete(self) -> None:
+        for ep in self.endpoints:
+            try:
+                self._call(ep, {"op": "complete", "trainer": self.trainer_id})
+            except (EOFError, ConnectionError, RuntimeError):
+                pass
+
+    def close(self):
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._conns.clear()
+
+
+class PServerRuntime:
+    """The listen_and_serv event loop: owns a scope of parameter blocks and
+    per-gradient optimize programs; serves send/get/barrier until every
+    trainer sends `complete`."""
+
+    def __init__(self, endpoint: str, n_trainers: int, sync_mode: bool,
+                 blocks: list[dict], scope, executor):
+        """blocks: [{grad, param, optimize_program, sparse,
+                     origin_param?, begin?, rows?}]"""
+        self.endpoint = endpoint
+        self.n_trainers = n_trainers
+        self.sync_mode = sync_mode
+        self.blocks = {b["grad"]: b for b in blocks}
+        self.scope = scope
+        self.exe = executor
+        # row-sliced params: carve this server's slice out of the full
+        # startup-initialized value (reference get_startup_program splits
+        # init ops; equal-seed init + slicing is equivalent)
+        for b in blocks:
+            rows = b.get("rows")
+            if rows is not None and b["param"] != b.get("origin_param"):
+                full = scope.find_var(b["origin_param"])
+                if full is None:
+                    raise RuntimeError(
+                        f"pserver scope missing '{b['origin_param']}' — run "
+                        f"the startup program first")
+                begin = int(b.get("begin", 0))
+                scope.set_var(b["param"],
+                              np.asarray(full)[begin:begin + rows].copy())
+        self._lock = threading.Lock()
+        self._grad_buf: dict[str, dict[int, Any]] = {}
+        self._barrier_waiting: list = []
+        self._barriers_seen: set[int] = set()
+        self._completed: set[int] = set()
+        self._step = 0
+        self._shutdown = threading.Event()
+
+    # -- request handlers ----------------------------------------------------
+    def _handle_send(self, msg):
+        name = msg["name"]
+        kind = msg["value"][0]
+        with self._lock:
+            buf = self._grad_buf.setdefault(name, {})
+            if kind == "sparse" and msg["trainer"] in buf:
+                # accumulate repeated sparse sends within a round
+                prev = buf[msg["trainer"]]
+                buf[msg["trainer"]] = ("sparse",
+                                       np.concatenate([prev[1], msg["value"][1]]),
+                                       np.concatenate([prev[2], msg["value"][2]]),
+                                       msg["value"][3])
+            else:
+                buf[msg["trainer"]] = msg["value"]
+            if not self.sync_mode:
+                self._apply_one(name)
+        return True
+
+    def _apply_one(self, grad_name):
+        """Async mode: apply immediately with whatever arrived."""
+        buf = self._grad_buf.get(grad_name, {})
+        for tid in list(buf):
+            self._apply_update(grad_name, [buf.pop(tid)], scale=1.0)
+
+    def _handle_barrier(self, msg, conn):
+        with self._lock:
+            self._barriers_seen.add(msg["trainer"])
+            self._barrier_waiting.append(conn)
+            ready = len(self._barriers_seen) >= self._active_trainers()
+            if ready:
+                self._run_round()
+                waiting, self._barrier_waiting = self._barrier_waiting, []
+                self._barriers_seen = set()
+                for c in waiting:
+                    try:
+                        c.send(("ok", None))
+                    except Exception:
+                        pass
+                return None  # replies already sent
+        return "wait"  # reply deferred until the round completes
+
+    def _active_trainers(self):
+        return self.n_trainers - len(self._completed)
+
+    def _run_round(self):
+        for grad_name, buf in list(self._grad_buf.items()):
+            vals = [buf[t] for t in sorted(buf)]
+            if not vals:
+                continue
+            self._apply_update(grad_name, vals, scale=1.0 / max(len(vals), 1))
+            self._grad_buf[grad_name] = {}
+        self._step += 1
+
+    def _apply_update(self, grad_name, payloads, scale: float):
+        from ..core.selected_rows import SelectedRows
+
+        spec = self.blocks.get(grad_name)
+        if spec is None:
+            return
+        if payloads[0][0] == "sparse":
+            rows = np.concatenate([p[1] for p in payloads])
+            vals = np.concatenate([p[2] for p in payloads]) * scale
+            grad = SelectedRows(rows, vals, payloads[0][3])
+        else:
+            acc = payloads[0][1].astype(np.float32).copy()
+            for p in payloads[1:]:
+                acc += p[1]
+            grad = acc * scale
+        from ..executor import scope_guard
+
+        with scope_guard(self.scope):
+            self.exe.run(spec["optimize_program"], feed={grad_name: grad})
+
+    def _handle_get(self, msg):
+        with self._lock:
+            v = self.scope.find_var(msg["name"])
+        if v is None:
+            raise KeyError(f"pserver has no var '{msg['name']}'")
+        return np.asarray(v)
+
+    # -- event loop ----------------------------------------------------------
+    def serve(self):
+        listener = Listener(_parse_ep(self.endpoint), authkey=_AUTHKEY)
+        threads = []
+        while not self._shutdown.is_set():
+            try:
+                listener._listener._socket.settimeout(1.0)
+                conn = listener.accept()
+            except Exception:
+                continue
+            t = threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        listener.close()
+
+    def _client_loop(self, conn):
+        while not self._shutdown.is_set():
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            try:
+                op = msg["op"]
+                if op == "send":
+                    conn.send(("ok", self._handle_send(msg)))
+                elif op == "get":
+                    conn.send(("ok", self._handle_get(msg)))
+                elif op == "barrier":
+                    r = self._handle_barrier(msg, conn)
+                    if r == "wait":
+                        pass  # reply comes when the round completes
+                elif op == "complete":
+                    with self._lock:
+                        self._completed.add(msg["trainer"])
+                        done = len(self._completed) >= self.n_trainers
+                        # release any trainers stuck on the barrier
+                        if self._barriers_seen and (
+                                len(self._barriers_seen)
+                                >= self._active_trainers()):
+                            self._run_round()
+                            for c in self._barrier_waiting:
+                                try:
+                                    c.send(("ok", None))
+                                except Exception:
+                                    pass
+                            self._barrier_waiting = []
+                            self._barriers_seen = set()
+                    conn.send(("ok", None))
+                    if done:
+                        self._shutdown.set()
+                        return
+                else:
+                    conn.send(("err", f"unknown op {msg['op']}"))
+            except Exception as e:  # serve must not die on one bad request
+                try:
+                    conn.send(("err", f"{type(e).__name__}: {e}"))
+                except Exception:
+                    return

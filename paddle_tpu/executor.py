@@ -135,6 +135,34 @@ class _Compiled:
         self.fetch_names = fetch_names
 
 
+def _has_host_ops(block) -> bool:
+    from .ops.registry import has_op
+
+    return any(
+        has_op(op.type) and get_op_def(op.type).host
+        for op in block.ops
+        if op.type not in _SKIP_OPS
+    )
+
+
+def _split_segments(ops):
+    """Partition ops into alternating ("jit", [ops...]) / ("host", [op])
+    segments (SURVEY §7: blocks with host ops lower as jit segments around
+    them — RPC send/recv, print, py_func)."""
+    segs, cur = [], []
+    for op in ops:
+        if get_op_def(op.type).host:
+            if cur:
+                segs.append(("jit", cur))
+                cur = []
+            segs.append(("host", [op]))
+        else:
+            cur.append(op)
+    if cur:
+        segs.append(("jit", cur))
+    return segs
+
+
 def _analyze_block(block, feed_names: list[str], scope: Scope):
     """Def-use analysis: which names come from the scope (ro/rw state)."""
     defined = set(feed_names)
@@ -221,6 +249,101 @@ def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env
     return fn
 
 
+class _SegmentedFn:
+    """Executes a block containing host ops: jit segments on-device, host ops
+    (RPC send/recv, listen_and_serv, print) eagerly between them. Same
+    call contract as the whole-block jitted fn."""
+
+    def __init__(self, block, feed_names, ro_names, rw_names, extra_w, fetch_names):
+        self.feed_names = feed_names
+        self.ro = ro_names
+        self.rw = rw_names
+        self.extra = extra_w
+        self.fetch = fetch_names
+        ops = [op for op in block.ops if op.type not in _SKIP_OPS]
+        raw_segs = _split_segments(ops)
+        need_later: list[set] = [set()] * len(raw_segs)
+        acc = set(fetch_names) | set(rw_names) | set(extra_w)
+        for i in range(len(raw_segs) - 1, -1, -1):
+            need_later[i] = set(acc)
+            acc |= {n for op in raw_segs[i][1] for n in op.input_names if n}
+        self.segments = []
+        for i, (kind, seg_ops) in enumerate(raw_segs):
+            if kind == "host":
+                self.segments.append(("host", seg_ops, None, None, None))
+                continue
+            defined = set()
+            in_names = []
+            for op in seg_ops:
+                for n in op.input_names:
+                    if n and n not in defined and n not in in_names:
+                        in_names.append(n)
+                defined.update(n for n in op.output_names if n)
+            out_names = [n for n in dict.fromkeys(
+                n for op in seg_ops for n in op.output_names if n)
+                if n in need_later[i]]
+            fn = jax.jit(self._make_segment_fn(block, seg_ops, in_names, out_names))
+            self.segments.append(("jit", seg_ops, in_names, out_names, fn))
+
+    @staticmethod
+    def _make_segment_fn(block, seg_ops, in_names, out_names):
+        def fn(in_vals, key):
+            env: dict[str, Any] = {"__rng_key": key}
+            env.update({n: v for n, v in zip(in_names, in_vals) if v is not None})
+
+            def lowerer(block_idx):
+                sub = block.program.blocks[block_idx]
+                return lambda sub_env: _run_ops_traced(sub, sub_env)
+
+            for op in seg_ops:
+                opdef = get_op_def(op.type)
+                rng = None
+                if opdef.needs_rng:
+                    key_new, sub = jax.random.split(env["__rng_key"])
+                    env["__rng_key"] = key_new
+                    rng = sub
+                ctx = ExecContext(op, env, rng=rng, lowerer=lowerer)
+                outs = _compute_op(opdef, ctx, op)
+                _maybe_check_finite(op, outs)
+                for slot, val in outs.items():
+                    names = op.outputs.get(slot, [])
+                    vals = val if isinstance(val, (list, tuple)) else [val]
+                    for n, v in zip(names, vals):
+                        if n and v is not None:
+                            env[n] = v
+            return tuple(env.get(n) for n in out_names)
+
+        return fn
+
+    def __call__(self, feed_vals, ro_vals, rw_vals, key):
+        env: dict[str, Any] = {}
+        env.update(zip(self.ro, ro_vals))
+        env.update(zip(self.rw, rw_vals))
+        env.update(zip(self.feed_names, feed_vals))
+        for i, (kind, seg_ops, in_names, out_names, fn) in enumerate(self.segments):
+            if kind == "jit":
+                vals = fn(tuple(env.get(n) for n in in_names),
+                          jax.random.fold_in(key, i))
+                for n, v in zip(out_names, vals):
+                    if v is not None:
+                        env[n] = v
+            else:
+                op = seg_ops[0]
+                opdef = get_op_def(op.type)
+                ctx = ExecContext(op, env, rng=None, lowerer=None)
+                outs = _compute_op(opdef, ctx, op)
+                for slot, val in outs.items():
+                    names = op.outputs.get(slot, [])
+                    vals = val if isinstance(val, (list, tuple)) else [val]
+                    for n, v in zip(names, vals):
+                        if n and v is not None:
+                            env[n] = v
+        fetches = tuple(env[n] for n in self.fetch)
+        new_rw = tuple(env[n] for n in self.rw)
+        new_extra = tuple(env[n] for n in self.extra)
+        return fetches, new_rw, new_extra
+
+
 def _run_ops_traced(block, env, key=None):
     """Trace a sub-block's ops against an existing env (control flow).
     Provides its own lowerer so control-flow ops nest arbitrarily. The RNG
@@ -294,14 +417,16 @@ class Executor:
                     "directly (dp-sharding inside stages is planned)")
             return program._pipeline.run_step(self, scope, feed, fetch_names)
 
+        from .core.selected_rows import is_selected_rows
+
         block = program.global_block
         feed_names = sorted(feed)
         feed_vals = []
         for n in feed_names:
             v = feed[n]
-            if not isinstance(v, jax.Array):
+            if not isinstance(v, jax.Array) and not is_selected_rows(v):
                 # host data: cast to the var's declared dtype; device arrays
-                # (e.g. pipeline stage transfers) pass through untouched
+                # and SelectedRows (pserver sparse grads) pass through
                 v = np.asarray(v)
                 try:
                     var = block.var(n)
@@ -320,9 +445,15 @@ class Executor:
                 tuple(mesh.devices.shape),
                 tuple(d.id for d in mesh.devices.flat),
             )
+        def _sig_of(v):
+            if is_selected_rows(v):
+                return ("sr", tuple(v.rows.shape), tuple(v.values.shape),
+                        str(v.values.dtype), v.height)
+            return (tuple(v.shape), str(v.dtype))
+
         sig = (
             program._version,
-            tuple((n, fv.shape, str(fv.dtype)) for n, fv in zip(feed_names, feed_vals)),
+            tuple((n,) + _sig_of(fv) for n, fv in zip(feed_names, feed_vals)),
             tuple(fetch_names),
             mesh_key,
             spmd_mode,
@@ -383,6 +514,16 @@ class Executor:
             return [np.asarray(x) for x in fetches]
         return list(fetches)
 
+    def close(self):
+        """Notify pservers this trainer is done (reference executor.cc:95
+        SendComplete via exe.close())."""
+        from .distributed.ps_rpc import PSClient
+
+        for client in list(PSClient._instances.values()):
+            client.send_complete()
+            client.close()
+        PSClient._instances.clear()
+
     # -- internals ----------------------------------------------------------
     def _fetch_state(self, scope: Scope, name: str):
         v = scope.find_var(name)
@@ -397,6 +538,17 @@ class Executor:
         self, program, block, feed_names, feed_vals, fetch_names, scope, mesh, spmd_mode="gspmd"
     ):
         ro_names, rw_names, extra_w = _analyze_block(block, feed_names, scope)
+
+        if _has_host_ops(block):
+            if mesh is not None:
+                raise NotImplementedError(
+                    "host ops (send/recv/listen_and_serv) cannot run under a "
+                    "device mesh; the pserver path is host-RPC over DCN")
+            fn = _SegmentedFn(block, feed_names, ro_names, rw_names, extra_w,
+                              fetch_names)
+            comp = _Compiled(fn, feed_names, ro_names, rw_names, fetch_names)
+            comp.extra_w = extra_w
+            return comp
 
         if mesh is not None and spmd_mode == "shard_map":
             # fleet/transpiler regime: bind mesh axes so c_* collective ops

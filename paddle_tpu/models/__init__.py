@@ -5,6 +5,8 @@ MNIST MLP, ResNet image classification, Transformer/BERT, word2vec, DeepFM.
 Each builder appends to the current default main/startup programs (use
 `program_guard` for isolation) and returns the named output Variables.
 """
+from . import deepfm  # noqa: F401
 from . import mlp  # noqa: F401
 from . import resnet  # noqa: F401
 from . import transformer  # noqa: F401
+from . import word2vec  # noqa: F401

@@ -1,0 +1,109 @@
+"""Distributed (pserver-path) ops: send / recv / barriers / listen_and_serv.
+
+TPU-native equivalents of /root/reference/paddle/fluid/operators/
+distributed_ops/ (send_op.cc, recv_op.cc, send_barrier_op.cc,
+fetch_barrier_op.cc, listen_and_serv_op.cc). These are HOST ops (host=True):
+the executor runs them outside jit, splitting the block into XLA segments
+around them — dense compute stays on-chip, the variable RPC rides host DCN.
+
+Slicing: a dense var sent/recv'd with `sections`/`epmap` attrs is split by
+rows across pservers (reference slice_variable contract); sparse
+(SelectedRows) grads go whole to their assigned endpoint.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import ExecContext, register_op
+
+
+def _client(ctx: ExecContext):
+    from ..distributed.ps_rpc import PSClient
+
+    eps = list(ctx.attr("endpoints", []))
+    return PSClient.get(eps, int(ctx.attr("trainer_id", 0)))
+
+
+@register_op("send", grad="none", host=True)
+def send(ctx: ExecContext):
+    """inputs X: vars to send; attrs: epmap (endpoint per section), sections
+    (row counts per section, empty = whole var), endpoints, trainer_id."""
+    client = _client(ctx)
+    epmap = list(ctx.attr("epmap", []))
+    sections = list(ctx.attr("sections", []))
+    for name, val in zip(ctx.op.inputs.get("X", []), ctx.inputs("X")):
+        if val is None:
+            continue
+        if hasattr(val, "rows"):  # SelectedRows: whole-table to one endpoint
+            client.send_var(epmap[0], name, val)
+            continue
+        if len(sections) <= 1:
+            client.send_var(epmap[0], name, np.asarray(val))
+        else:
+            arr = np.asarray(val)
+            offs = np.cumsum([0] + sections[:-1])
+            for j, (ep, off, rows) in enumerate(zip(epmap, offs, sections)):
+                client.send_var(ep, f"{name}.block{j}", arr[off:off + rows])
+    return {}
+
+
+@register_op("send_barrier", grad="none", host=True)
+def send_barrier(ctx: ExecContext):
+    _client(ctx).send_barrier()
+    return {}
+
+
+@register_op("fetch_barrier", grad="none", host=True)
+def fetch_barrier(ctx: ExecContext):
+    _client(ctx).fetch_barrier()
+    return {}
+
+
+@register_op("recv", grad="none", host=True)
+def recv(ctx: ExecContext):
+    """outputs Out: vars to fill; attrs as `send`. Sliced vars concat by row
+    (reference recv + concat pattern, distribute_transpiler.py get_trainer_program)."""
+    client = _client(ctx)
+    epmap = list(ctx.attr("epmap", []))
+    sections = list(ctx.attr("sections", []))
+    outs = []
+    for name in ctx.op.outputs.get("Out", []):
+        if len(sections) <= 1:
+            outs.append(client.get_var(epmap[0], name))
+        else:
+            parts = [client.get_var(ep, f"{name}.block{j}")
+                     for j, ep in enumerate(epmap)]
+            outs.append(np.concatenate(parts, axis=0))
+    return {"Out": outs}
+
+
+@register_op("listen_and_serv", grad="none", host=True)
+def listen_and_serv(ctx: ExecContext):
+    """The pserver event loop (blocks until all trainers send_complete).
+    attrs carry the serving spec; the optimize sub-programs arrive as
+    serialized program dicts (Program.to_dict)."""
+    from ..distributed.ps_rpc import PServerRuntime
+    from ..executor import Executor, global_scope
+    from ..framework import Program
+
+    blocks = []
+    for spec in ctx.attr("block_specs", []):
+        blocks.append({
+            "grad": spec["grad"],
+            "param": spec["param"],
+            "origin_param": spec.get("origin_param", spec["param"]),
+            "begin": spec.get("begin", 0),
+            "rows": spec.get("rows"),
+            "sparse": spec.get("sparse", False),
+            "optimize_program": Program.from_dict(spec["optimize_program"]),
+        })
+    rt = PServerRuntime(
+        endpoint=ctx.attr("endpoint"),
+        n_trainers=int(ctx.attr("Fanin", 1)),
+        sync_mode=bool(ctx.attr("sync_mode", True)),
+        blocks=blocks,
+        scope=global_scope(),
+        executor=Executor(),
+    )
+    rt.serve()
+    return {}

@@ -99,7 +99,20 @@ def scale(ctx: ExecContext):
 
 @register_op("sum")
 def sum_op(ctx: ExecContext):
+    """Adds its inputs. SelectedRows inputs merge by row concatenation
+    (reference math/selected_rows_functor.cc add semantics) — all-sparse
+    stays sparse; a sparse/dense mix densifies."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
     xs = [x for x in ctx.inputs("X") if x is not None]
+    if any(is_selected_rows(x) for x in xs):
+        if all(is_selected_rows(x) for x in xs):
+            return {"Out": SelectedRows(
+                jnp.concatenate([x.rows for x in xs]),
+                jnp.concatenate([x.values for x in xs]),
+                xs[0].height,
+            )}
+        xs = [x.to_dense() if is_selected_rows(x) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -378,6 +378,35 @@ def lookup_table_v2(ctx: ExecContext):
     return lookup_table(ctx)
 
 
+@register_grad_compute("lookup_table")
+def lookup_table_grad(ctx: ExecContext):
+    """W grad: dense scatter-add, or a SelectedRows row-set when is_sparse —
+    the reference's SelectedRows grad path (lookup_table_op.cc grad kernel +
+    selected_rows.h:32), kept fixed-shape for XLA."""
+    from ..core.selected_rows import SelectedRows
+
+    w, ids, og = ctx.input("W"), ctx.input("Ids"), ctx.input("Out@GRAD")
+    if og is None:
+        return {"W@GRAD": jnp.zeros_like(w)}
+    idsq = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    idsq = idsq.astype(np.int32)
+    width = og.shape[-1]
+    padding_idx = ctx.attr("padding_idx", -1)
+    rows = idsq.reshape(-1)
+    vals = og.reshape(-1, width)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = jnp.where((rows == padding_idx)[:, None], jnp.zeros_like(vals), vals)
+    if ctx.attr("is_sparse", False):
+        return {"W@GRAD": SelectedRows(rows, vals, height=w.shape[0])}
+    dense = jnp.zeros_like(w).at[rows].add(vals.astype(w.dtype))
+    return {"W@GRAD": dense}
+
+
+from .registry import _REGISTRY as _REG  # noqa: E402
+
+_REG["lookup_table_v2_grad"] = _REG["lookup_table_grad"]
+
+
 @register_op("accuracy", grad="none")
 def accuracy(ctx: ExecContext):
     idx, label = ctx.input("Indices"), ctx.input("Label")

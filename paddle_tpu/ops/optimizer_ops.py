@@ -22,15 +22,34 @@ def _lr(ctx):
     return lr.reshape(()) if lr.ndim else lr
 
 
+def _reject_sparse(ctx, g):
+    from ..core.selected_rows import is_selected_rows
+
+    if is_selected_rows(g):
+        raise NotImplementedError(
+            f"op '{ctx.op.type}' does not support SelectedRows (sparse) "
+            f"gradients; use SGD for is_sparse embeddings, or "
+            f"is_sparse=False (XLA fuses the dense scatter-add)")
+    return g
+
+
 @register_op("sgd", grad="none", stateful_outputs=("ParamOut",))
 def sgd(ctx: ExecContext):
+    """Dense update, or a sparse row-wise update for SelectedRows grads (the
+    reference sgd_op.cc SelectedRows kernel): duplicates accumulate via
+    scatter-add, rows untouched by the batch keep their values."""
+    from ..core.selected_rows import is_selected_rows
+
     p, g = ctx.input("Param"), ctx.input("Grad")
+    if is_selected_rows(g):
+        upd = (_lr(ctx) * g.values).astype(p.dtype)
+        return {"ParamOut": p.at[g.rows].add(-upd)}
     return {"ParamOut": p - (_lr(ctx) * g).astype(p.dtype)}
 
 
 @register_op("momentum", grad="none", stateful_outputs=("ParamOut", "VelocityOut"))
 def momentum(ctx: ExecContext):
-    p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
+    p, g, v = ctx.input("Param"), _reject_sparse(ctx, ctx.input("Grad")), ctx.input("Velocity")
     mu = ctx.attr("mu")
     lr = _lr(ctx)
     v_new = mu * v + g
@@ -48,7 +67,7 @@ def momentum(ctx: ExecContext):
 )
 def adam(ctx: ExecContext):
     p = ctx.input("Param")
-    g = ctx.input("Grad").astype(jnp.float32)
+    g = _reject_sparse(ctx, ctx.input("Grad")).astype(jnp.float32)
     m1 = ctx.input("Moment1")
     m2 = ctx.input("Moment2")
     b1p = ctx.input("Beta1Pow").reshape(())

@@ -80,6 +80,7 @@ class OpDef:
         needs_rng: bool = False,
         no_grad: bool = False,
         stateful_outputs: tuple = (),
+        host: bool = False,
     ):
         self.type = type
         self.compute = compute
@@ -90,6 +91,11 @@ class OpDef:
         # output slots that alias an input (in-place update contract, e.g.
         # sgd's ParamOut) — used by the executor for donation bookkeeping
         self.stateful_outputs = stateful_outputs
+        # host=True: side-effecting op that must run OUTSIDE jit (RPC
+        # send/recv, print, py_func) — the executor splits the block into jit
+        # segments around these (SURVEY §7: segment partitioning; the
+        # reference's data_transform/host-op analogue)
+        self.host = host
 
 
 _REGISTRY: dict[str, OpDef] = {}
@@ -103,6 +109,7 @@ def register_op(
     needs_rng=False,
     no_grad=False,
     stateful_outputs=(),
+    host=False,
 ):
     """Decorator: register `compute` for op `type`.
 
@@ -123,6 +130,7 @@ def register_op(
             needs_rng=needs_rng,
             no_grad=is_no_grad,
             stateful_outputs=stateful_outputs,
+            host=host,
         )
         return compute
 
@@ -301,6 +309,8 @@ def infer_op(op, block) -> None:
         opdef = get_op_def(op.type)
     except KeyError:
         return  # unknown op (e.g. feed/fetch markers) — nothing to infer
+    if opdef.host:
+        return  # host ops (RPC etc.) must never run at infer time
     if opdef.infer is not None:
         opdef.infer(op, block)
         return

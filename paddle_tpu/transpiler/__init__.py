@@ -1,0 +1,7 @@
+"""Program transpilers (reference python/paddle/fluid/transpiler/)."""
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    slice_variable,
+)
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin  # noqa: F401

@@ -1,0 +1,275 @@
+"""DistributeTranspiler: rewrite a trained program into trainer + pserver
+halves (reference python/paddle/fluid/transpiler/distribute_transpiler.py:
+transpile:441, slice_variable:85, get_trainer_program:777,
+get_pserver_program:911).
+
+Contract kept:
+  * user builds model + optimizer.minimize(loss), then transpiles;
+  * the trainer program loses its optimizer ops and gains send / send_barrier
+    / recv / fetch_barrier host ops after the backward ops;
+  * each pserver program is one `listen_and_serv` op whose block_specs carry
+    the per-parameter optimize sub-programs (the reference's per-grad
+    optimize blocks), executed by the PServerRuntime event loop;
+  * parameter placement balances by size (RoundRobin over size-sorted vars);
+    large plain-SGD dense params are row-sliced across pservers
+    (slice_variable); params with optimizer accumulators and sparse embedding
+    tables are placed whole.
+
+TPU-native departures: dense compute (fwd+bwd) lowers to XLA segments around
+the host RPC ops (executor segmentation); pserver startup reuses the original
+startup program — with equal random_seed, trainer-local init equals pserver
+init, replacing the reference's moved init ops. Sync aggregation averages
+trainer gradients (the fleet GradAllReduce `avg` convention), so N trainers
+over batch shards reproduce single-process full-batch training.
+"""
+from __future__ import annotations
+
+import copy
+import math
+
+import numpy as np
+
+from ..framework import Program, default_main_program, default_startup_program
+from .ps_dispatcher import RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig", "slice_variable"]
+
+
+class DistributeTranspilerConfig:
+    """Reference distribute_transpiler.py:131."""
+
+    slice_var_up = True
+    min_block_size = 8192
+    split_method = RoundRobin
+
+
+class VarBlock:
+    def __init__(self, varname, block_id, begin, size):
+        self.varname = varname
+        self.block_id = block_id
+        self.begin = begin  # row offset
+        self.size = size    # rows
+
+    def __str__(self):
+        return f"{self.varname}:{self.block_id}:{self.size}"
+
+
+def slice_variable(var_list, slice_count, min_block_size=8192):
+    """Split vars into row-blocks, >= min_block_size elements each, at most
+    slice_count blocks per var (reference slice_variable :85)."""
+    blocks = []
+    for var in var_list:
+        rows = var.shape[0] if var.shape else 1
+        row_width = int(np.prod(var.shape[1:])) if len(var.shape) > 1 else 1
+        numel = rows * row_width
+        split_count = min(slice_count, max(numel // min_block_size, 1))
+        split_count = min(split_count, rows)
+        per = int(math.ceil(rows / split_count))
+        begin = 0
+        bid = 0
+        while begin < rows:
+            size = min(per, rows - begin)
+            blocks.append(VarBlock(var.name, bid, begin, size))
+            begin += size
+            bid += 1
+    return blocks
+
+
+# op types whose (Param, Grad) input slots mark them as optimize ops
+def _is_optimize_op(op) -> bool:
+    return "Param" in op.inputs and "Grad" in op.inputs
+
+
+class DistributeTranspiler:
+    def __init__(self, config: DistributeTranspilerConfig | None = None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # -- main entry ----------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint=None):
+        self.trainer_id = trainer_id
+        self.n_trainers = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.eps = [e.strip() for e in pservers.split(",") if e.strip()]
+
+        block = self.origin_program.global_block
+        self._opt_ops = [op for op in block.ops if _is_optimize_op(op)]
+        if not self._opt_ops:
+            raise ValueError(
+                "transpile() needs a program with optimizer ops — call "
+                "optimizer.minimize(loss) first (reference flow)")
+        sparse_params = {
+            op.inputs["W"][0]
+            for op in block.ops
+            if op.type.startswith("lookup_table") and op.attr("is_sparse", False)
+        }
+
+        # placement: size-desc round robin (reference same-size balancing)
+        infos = []
+        for op in self._opt_ops:
+            pname, gname = op.inputs["Param"][0], op.inputs["Grad"][0]
+            pvar = block.var(pname)
+            infos.append({
+                "op": op, "param": pname, "grad": gname, "var": pvar,
+                "numel": int(np.prod(pvar.shape)) if pvar.shape else 1,
+                "sparse": pname in sparse_params,
+            })
+        infos.sort(key=lambda d: -d["numel"])
+        dispatcher = self.config.split_method(self.eps)
+        self.param_blocks = []  # per param: {param, grad, eps, sections, sparse, specs}
+        for info in infos:
+            sliceable = (
+                self.config.slice_var_up
+                and not info["sparse"]
+                and info["op"].type == "sgd"  # accumulator-free update
+                and len(self.eps) > 1
+                and info["var"].shape
+                and info["var"].shape[0] >= len(self.eps)
+                and info["numel"] >= self.config.min_block_size * 2
+            )
+            if sliceable:
+                vblocks = slice_variable([info["var"]], len(self.eps),
+                                         self.config.min_block_size)
+                eps = dispatcher.dispatch(vblocks)
+                sections = [b.size for b in vblocks]
+                begins = [b.begin for b in vblocks]
+            else:
+                eps = dispatcher.dispatch([info["var"]])
+                sections = []
+                begins = [0]
+            self.param_blocks.append({
+                **info, "eps": eps, "sections": sections, "begins": begins,
+            })
+
+        self._build_pserver_specs()
+        self._rewrite_trainer_program()
+        return self
+
+    # -- pserver side --------------------------------------------------------
+    def _build_pserver_specs(self):
+        self._ep_specs: dict[str, list] = {ep: [] for ep in self.eps}
+        block = self.origin_program.global_block
+        for pb in self.param_blocks:
+            if pb["sections"]:
+                rows = [(b, s) for b, s in zip(pb["begins"], pb["sections"])]
+                for j, (ep, (begin, size)) in enumerate(zip(pb["eps"], rows)):
+                    spec = self._make_optimize_program(
+                        pb, block, begin=begin, rows=size, block_id=j)
+                    self._ep_specs[ep].append(spec)
+            else:
+                spec = self._make_optimize_program(pb, block)
+                self._ep_specs[pb["eps"][0]].append(spec)
+
+    def _make_optimize_program(self, pb, block, begin=0, rows=None,
+                               block_id=None) -> dict:
+        """Replay the optimize op into a standalone program over (possibly
+        row-sliced) vars; returns the serialized block spec."""
+        op = pb["op"]
+        sliced = block_id is not None
+        prog = Program()
+        dst = prog.global_block
+        wire_param = f"{pb['param']}.block{block_id}" if sliced else pb["param"]
+        wire_grad = f"{pb['grad']}.block{block_id}" if sliced else pb["grad"]
+
+        def _slice_shape(shape):
+            if not sliced or not shape:
+                return list(shape)
+            return [rows] + list(shape[1:])
+
+        inputs = {}
+        for slot, names in op.inputs.items():
+            new = []
+            for n in names:
+                v = block.var(n)
+                if slot == "Param":
+                    dst.create_var(name=wire_param,
+                                   shape=_slice_shape(v.shape),
+                                   dtype=v.dtype, persistable=True)
+                    new.append(wire_param)
+                elif slot == "Grad":
+                    dst.create_var(name=wire_grad,
+                                   shape=_slice_shape(v.shape),
+                                   dtype=v.dtype, is_data=True,
+                                   stop_gradient=True)
+                    new.append(wire_grad)
+                else:  # LearningRate, moments, beta pows: persistable state
+                    dst.create_var(name=n, shape=_slice_shape(v.shape)
+                                   if slot.startswith("Moment") else list(v.shape),
+                                   dtype=v.dtype, persistable=True)
+                    new.append(n)
+            inputs[slot] = new
+        outputs = {}
+        for slot, names in op.outputs.items():
+            new = []
+            for n in names:
+                if n == pb["param"]:
+                    new.append(wire_param)
+                elif n in dst.vars:
+                    new.append(n)
+                else:
+                    v = block.var(n)
+                    dst.create_var(name=n, shape=list(v.shape), dtype=v.dtype,
+                                   persistable=True)
+                    new.append(n)
+            outputs[slot] = new
+        dst.append_op(op.type, inputs, outputs, copy.deepcopy(op.attrs))
+        return {
+            "grad": wire_grad,
+            "param": wire_param,
+            "origin_param": pb["param"],
+            "begin": begin,
+            "rows": rows,
+            "sparse": pb["sparse"],
+            "optimize_program": prog.to_dict(),
+        }
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        if endpoint not in self._ep_specs:
+            raise ValueError(f"unknown pserver endpoint {endpoint}; "
+                             f"known: {self.eps}")
+        prog = Program()
+        prog.global_block.append_op(
+            "listen_and_serv", {}, {},
+            {
+                "endpoint": endpoint,
+                "Fanin": self.n_trainers,
+                "sync_mode": self.sync_mode,
+                "block_specs": self._ep_specs[endpoint],
+            },
+        )
+        return prog
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        """Pserver init: the ORIGINAL startup program — equal random_seed
+        makes pserver param init identical to trainer init (replaces the
+        reference's moved init ops)."""
+        return startup_program or self.startup_program
+
+    # -- trainer side --------------------------------------------------------
+    def _rewrite_trainer_program(self):
+        block = self.origin_program.global_block
+        opt_set = set(id(op) for op in self._opt_ops)
+        block.ops = [op for op in block.ops if id(op) not in opt_set]
+        common = {"endpoints": self.eps, "trainer_id": self.trainer_id}
+        for pb in self.param_blocks:
+            block.append_op(
+                "send", {"X": [pb["grad"]]}, {},
+                {"epmap": pb["eps"], "sections": pb["sections"],
+                 "sparse": pb["sparse"], **common},
+            )
+        if self.sync_mode:
+            block.append_op("send_barrier", {}, {}, dict(common))
+        for pb in self.param_blocks:
+            block.append_op(
+                "recv", {}, {"Out": [pb["param"]]},
+                {"epmap": pb["eps"], "sections": pb["sections"], **common},
+            )
+        if self.sync_mode:
+            block.append_op("fetch_barrier", {}, {}, dict(common))
+
+    def get_trainer_program(self, wait_port=True) -> Program:
+        return self.origin_program

@@ -1,0 +1,40 @@
+"""Parameter-block -> pserver endpoint dispatchers
+(reference python/paddle/fluid/transpiler/ps_dispatcher.py)."""
+from __future__ import annotations
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    def dispatch(self, varlist):
+        # accepts Variables (.name) and VarBlocks (.varname)
+        def _name(v):
+            return getattr(v, "name", None) or v.varname
+
+        return [
+            self._eps[sum(ord(c) for c in _name(v)) % len(self._eps)]
+            for v in varlist
+        ]

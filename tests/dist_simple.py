@@ -1,0 +1,94 @@
+"""Distributed-test model runner (reference unittests/dist_mnist.py pattern):
+one script, three roles — `local`, `pserver`, `trainer` — so the pserver path
+can be exercised with real subprocesses on localhost (TestDistBase :442).
+
+usage: dist_simple.py ROLE EPS TRAINER_ID N_TRAINERS OUT_NPZ [CURRENT_EP]
+"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+
+STEPS = 5
+FULL_BATCH = 32
+
+
+def build():
+    x = L.data(name="x", shape=[16], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    h = L.fc(x, size=512, act="relu")  # big enough to row-slice over pservers
+    pred = L.fc(h, size=1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    return loss
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((FULL_BATCH, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 1)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def main():
+    role, eps, trainer_id, n_trainers, out = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5])
+    current_ep = sys.argv[6] if len(sys.argv) > 6 else None
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = 7
+    startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            loss = build()
+            pt.optimizer.SGD(0.1).minimize(loss)
+
+    exe = pt.Executor()
+    x, y = full_data()
+
+    if role == "local":
+        exe.run(startup)
+        for _ in range(STEPS):
+            (lv,) = exe.run(main_p, feed={"x": x, "y": y},
+                            fetch_list=[loss.name])
+        _dump(out, main_p, float(np.asarray(lv).reshape(-1)[0]))
+        return
+
+    t = pt.DistributeTranspiler()
+    t.transpile(trainer_id, program=main_p, pservers=eps,
+                trainers=n_trainers, sync_mode=True, startup_program=startup)
+
+    if role == "pserver":
+        exe.run(t.get_startup_program())
+        exe.run(t.get_pserver_program(current_ep))  # blocks until complete
+        return
+
+    # trainer
+    exe.run(startup)
+    prog = t.get_trainer_program()
+    shard = FULL_BATCH // n_trainers
+    lo = trainer_id * shard
+    xs, ys = x[lo:lo + shard], y[lo:lo + shard]
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss.name])
+    exe.close()
+    _dump(out, main_p, float(np.asarray(lv).reshape(-1)[0]))
+
+
+def _dump(out, program, last_loss):
+    vals = {
+        p.name: np.asarray(pt.global_scope().find_var(p.name))
+        for p in program.all_parameters()
+    }
+    vals["__last_loss__"] = np.asarray(last_loss)
+    np.savez(out, **vals)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""End-to-end pserver training on localhost subprocesses (reference
+unittests/test_dist_base.py:442 TestDistBase._run_cluster): 2 trainers over
+batch shards + 2 pservers (row-sliced fc weight) must reproduce the
+single-process full-batch parameter trajectory."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+_SCRIPT = os.path.join(_DIR, "dist_simple.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args):
+    return subprocess.Popen(
+        [sys.executable, _SCRIPT, *args], env=_env(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def test_pserver_cluster_matches_local(tmp_path):
+    eps = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    ep_list = eps.split(",")
+
+    local_out = str(tmp_path / "local.npz")
+    p = _spawn(["local", eps, "0", "2", local_out])
+    out, _ = p.communicate(timeout=240)
+    assert p.returncode == 0, out.decode()[-2000:]
+
+    pservers = [
+        _spawn(["pserver", eps, "0", "2", str(tmp_path / f"ps{i}.npz"), ep])
+        for i, ep in enumerate(ep_list)
+    ]
+    trainers = [
+        _spawn(["trainer", eps, str(i), "2", str(tmp_path / f"tr{i}.npz")])
+        for i in range(2)
+    ]
+    try:
+        for i, t in enumerate(trainers):
+            out, _ = t.communicate(timeout=240)
+            assert t.returncode == 0, f"trainer {i}: {out.decode()[-3000:]}"
+        for i, ps in enumerate(pservers):
+            out, _ = ps.communicate(timeout=60)
+            assert ps.returncode == 0, f"pserver {i}: {out.decode()[-3000:]}"
+    finally:
+        for pr in trainers + pservers:
+            if pr.poll() is None:
+                pr.kill()
+
+    local = np.load(local_out)
+    tr0 = np.load(str(tmp_path / "tr0.npz"))
+    tr1 = np.load(str(tmp_path / "tr1.npz"))
+    for k in local.files:
+        if k == "__last_loss__":
+            continue
+        np.testing.assert_allclose(
+            local[k], tr0[k], rtol=1e-4, atol=1e-5,
+            err_msg=f"trainer0 param {k} diverged from local")
+        np.testing.assert_allclose(
+            tr0[k], tr1[k], rtol=1e-6, atol=1e-7,
+            err_msg=f"trainers disagree on param {k}")
+    assert float(local["__last_loss__"]) < 10.0

@@ -1,0 +1,118 @@
+"""DistributeTranspiler unit tests: pure program-transformation assertions,
+no networking (reference unittests/test_dist_transpiler.py pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.transpiler import slice_variable
+from paddle_tpu.transpiler.distribute_transpiler import VarBlock
+
+
+class _FakeVar:
+    def __init__(self, name, shape):
+        self.name = name
+        self.shape = tuple(shape)
+
+
+def test_slice_variable_blocks():
+    v = _FakeVar("w", (100, 100))  # 10k elements
+    blocks = slice_variable([v], 4, min_block_size=2048)
+    assert len(blocks) == 4
+    assert sum(b.size for b in blocks) == 100
+    assert all(isinstance(b, VarBlock) for b in blocks)
+    # small var -> one block
+    small = _FakeVar("b", (10,))
+    assert len(slice_variable([small], 4, min_block_size=2048)) == 1
+
+
+def _build_and_transpile(opt, trainers=2, pservers="1.1.1.1:1234,1.1.1.2:1234",
+                         sparse=False):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[64], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            h = L.fc(x, size=512, act="relu")  # 64x512 w: big enough to slice
+            if sparse:
+                ids = L.data(name="ids", shape=[2], dtype="int64")
+                emb = L.embedding(ids, size=[1000, 16], is_sparse=True,
+                                  param_attr=pt.ParamAttr(name="emb_w"))
+                h = L.concat([h, L.reduce_sum(emb, dim=1)], axis=1)
+            loss = L.mean(L.square_error_cost(L.fc(h, size=1), y))
+            opt.minimize(loss)
+    t = pt.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=pservers,
+                trainers=trainers, sync_mode=True, startup_program=startup)
+    return t, main
+
+
+def test_trainer_program_op_list():
+    t, main = _build_and_transpile(pt.optimizer.SGD(0.1))
+    types = [op.type for op in main.global_block.ops]
+    assert "sgd" not in types  # optimize ops moved to pserver
+    n_params = 4  # 2 fc layers x (w, b)
+    assert types.count("send") == n_params
+    assert types.count("recv") == n_params
+    assert types.count("send_barrier") == 1
+    assert types.count("fetch_barrier") == 1
+    # barriers sit between sends and recvs
+    assert types.index("send_barrier") > max(
+        i for i, t_ in enumerate(types) if t_ == "send")
+    assert types.index("fetch_barrier") > max(
+        i for i, t_ in enumerate(types) if t_ == "recv")
+
+
+def test_large_sgd_param_is_sliced_across_pservers():
+    t, main = _build_and_transpile(pt.optimizer.SGD(0.1))
+    send_ops = [op for op in main.global_block.ops if op.type == "send"]
+    sliced = [op for op in send_ops if op.attr("sections")]
+    assert sliced, "the 64x512 fc weight should be row-sliced"
+    op = sliced[0]
+    assert len(op.attr("epmap")) == len(op.attr("sections")) == 2
+    assert sum(op.attr("sections")) in (64, 512)  # rows of a fc weight
+
+
+def test_adam_params_are_whole_with_accumulator_state():
+    t, main = _build_and_transpile(pt.optimizer.Adam(0.001))
+    send_ops = [op for op in main.global_block.ops if op.type == "send"]
+    assert all(not op.attr("sections") for op in send_ops)
+    # each pserver optimize program contains one adam op with moment vars
+    specs = [s for eps in t._ep_specs.values() for s in eps]
+    prog = pt.Program.from_dict(specs[0]["optimize_program"])
+    assert [op.type for op in prog.global_block.ops] == ["adam"]
+    assert any("moment" in n for n in prog.global_block.vars)
+
+
+def test_sparse_embedding_goes_whole_to_one_pserver():
+    t, main = _build_and_transpile(pt.optimizer.SGD(0.1), sparse=True)
+    emb_sends = [
+        op for op in main.global_block.ops
+        if op.type == "send" and op.inputs["X"][0].startswith("emb_w")
+    ]
+    assert len(emb_sends) == 1
+    assert emb_sends[0].attr("sparse") is True
+    assert not emb_sends[0].attr("sections")
+    assert len(emb_sends[0].attr("epmap")) == 1
+
+
+def test_pserver_program_structure():
+    t, _ = _build_and_transpile(pt.optimizer.SGD(0.1))
+    prog = t.get_pserver_program("1.1.1.1:1234")
+    ops = prog.global_block.ops
+    assert len(ops) == 1 and ops[0].type == "listen_and_serv"
+    assert ops[0].attr("Fanin") == 2
+    assert ops[0].attr("sync_mode") is True
+    specs = ops[0].attr("block_specs")
+    assert specs, "endpoint must own at least one block"
+    with pytest.raises(ValueError, match="unknown pserver"):
+        t.get_pserver_program("9.9.9.9:1")
+
+
+def test_transpile_requires_optimize_ops():
+    with pt.program_guard(pt.Program(), pt.Program()):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        L.fc(x, size=2)
+        with pytest.raises(ValueError, match="minimize"):
+            pt.DistributeTranspiler().transpile(
+                0, program=pt.default_main_program(), trainers=1)

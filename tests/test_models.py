@@ -3,7 +3,7 @@ configs (reference book-test pattern, SURVEY.md §4)."""
 import numpy as np
 
 import paddle_tpu as pt
-from paddle_tpu.models import mlp, resnet, transformer
+from paddle_tpu.models import deepfm, mlp, resnet, transformer, word2vec
 
 
 def _fresh_programs():
@@ -55,6 +55,53 @@ def test_resnet_cifar_forward_backward():
             (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
     assert np.isfinite(l0) and np.isfinite(l1)
     assert float(l1) < float(l0)
+
+
+def test_deepfm_trains_with_sparse_grads():
+    guard, main, startup = _fresh_programs()
+    with guard:
+        avg_loss, predict, feeds = deepfm.deepfm(
+            n_fields=6, n_dense=4, vocab_size=500, embed_dim=8,
+            hidden_sizes=(32, 32), is_sparse=True)
+        pt.optimizer.SGD(learning_rate=0.05).minimize(avg_loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        B = 32
+        # learnable signal: label depends on one dense feature
+        dense = rng.standard_normal((B, 4)).astype(np.float32)
+        feed = {
+            "sparse_ids": rng.integers(0, 500, (B, 6)).astype(np.int64),
+            "dense_x": dense,
+            "label": (dense[:, :1] > 0).astype(np.float32),
+        }
+        hist = []
+        for _ in range(30):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[avg_loss])
+            hist.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert hist[-1] < hist[0] * 0.8, hist[::10]
+
+
+def test_word2vec_trains():
+    guard, main, startup = _fresh_programs()
+    with guard:
+        avg_loss, predict, feeds = word2vec.word2vec(
+            dict_size=100, embed_dim=8, hidden_size=32)
+        pt.optimizer.Adam(learning_rate=1e-2).minimize(avg_loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        B = 16
+        ctx = rng.integers(0, 100, (B, 4)).astype(np.int64)
+        feed = {f"w{i}": ctx[:, i:i+1] for i in range(4)}
+        feed["next_word"] = ((ctx.sum(1, keepdims=True)) % 100).astype(np.int64)
+        hist = []
+        for _ in range(20):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[avg_loss])
+            hist.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert hist[-1] < hist[0]
 
 
 def test_mnist_conv_builds():

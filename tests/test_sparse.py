@@ -1,0 +1,78 @@
+"""SelectedRows sparse embedding-gradient path (reference selected_rows.h:32 +
+lookup_table_op sparse grad + sgd_op SelectedRows kernel)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+def test_selected_rows_to_dense_merges_duplicates():
+    sr = SelectedRows(
+        rows=np.array([1, 3, 1], np.int32),
+        values=np.array([[1.0, 2.0], [3.0, 4.0], [10.0, 20.0]], np.float32),
+        height=5,
+    )
+    dense = np.asarray(sr.to_dense())
+    expect = np.zeros((5, 2), np.float32)
+    expect[1] = [11.0, 22.0]
+    expect[3] = [3.0, 4.0]
+    np.testing.assert_allclose(dense, expect)
+    uniq, merged = sr.merged()
+    np.testing.assert_array_equal(uniq, [1, 3])
+    np.testing.assert_allclose(merged, [[11.0, 22.0], [3.0, 4.0]])
+
+
+def _train_embedding(is_sparse, steps=5):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            ids = L.data(name="ids", shape=[4], dtype="int64")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            emb = L.embedding(ids, size=[50, 8], is_sparse=is_sparse,
+                              param_attr=pt.ParamAttr(name="emb_w"))
+            pooled = L.reduce_sum(emb, dim=1)
+            pred = L.fc(pooled, size=1)
+            loss = L.mean(L.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.default_rng(0)
+    idv = rng.integers(0, 50, (16, 4)).astype(np.int64)
+    yv = rng.standard_normal((16, 1)).astype(np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        hist = []
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"ids": idv, "y": yv},
+                            fetch_list=[loss.name])
+            hist.append(float(np.asarray(lv).reshape(-1)[0]))
+        w = np.asarray(scope.find_var("emb_w"))
+    return hist, w
+
+
+def test_sparse_embedding_grad_matches_dense():
+    """is_sparse=True (SelectedRows grad + sparse sgd scatter) must produce
+    the exact same trajectory as the dense scatter-add path."""
+    dense_hist, dense_w = _train_embedding(False)
+    sparse_hist, sparse_w = _train_embedding(True)
+    np.testing.assert_allclose(dense_hist, sparse_hist, rtol=1e-5)
+    np.testing.assert_allclose(dense_w, sparse_w, rtol=1e-5, atol=1e-6)
+    assert dense_hist[-1] < dense_hist[0]
+
+
+def test_sparse_grad_with_momentum_raises():
+    with pt.program_guard(pt.Program(), pt.Program()):
+        ids = L.data(name="ids", shape=[4], dtype="int64")
+        emb = L.embedding(ids, size=[20, 4], is_sparse=True)
+        loss = L.mean(L.reduce_sum(emb, dim=1))
+        pt.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        with pytest.raises(pt.OpError, match="SelectedRows"):
+            exe.run(pt.default_main_program(),
+                    feed={"ids": np.zeros((8, 4), np.int64)},
+                    fetch_list=[loss.name])
