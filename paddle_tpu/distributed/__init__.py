@@ -6,3 +6,4 @@ distributed_ops/listen_and_serv_op.cc): dense math runs on chips; the sparse/
 parameter-server path rides a host TCP variable service over DCN.
 """
 from . import ps_rpc  # noqa: F401
+from .parallel import ParallelEnv, init_parallel_env  # noqa: F401

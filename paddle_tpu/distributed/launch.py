@@ -1,0 +1,137 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-process job launcher.
+
+TPU-native re-design of the reference launcher
+(/root/reference/python/paddle/distributed/launch.py: start_procs:132,
+launch:243): same job shape — spawn one training process per device group,
+wire the rank/endpoint env contract, multiplex logs, propagate failures — but
+rendezvous is the PjRt coordination service (see distributed/parallel.py), not
+a trainer-0 socket broadcast of an ncclUniqueId.
+
+Usage:
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 \
+        [--backend=cpu --local_devices_per_proc=1] \
+        [--log_dir=log] train.py --your --args
+
+Each worker process receives:
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM   rank / world size
+    PADDLE_COORDINATOR                        coordination service address
+    PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT (fleet role makers)
+    PADDLE_DIST_BACKEND / PADDLE_LOCAL_DEVICES (optional platform pinning)
+and calls `paddle_tpu.distributed.init_parallel_env()` before building its
+program (fleet.init with PaddleCloudRoleMaker picks up the same envs).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a multi-process distributed job",
+    )
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes to spawn on this node")
+    p.add_argument("--node_ip", default="127.0.0.1",
+                   help="this node's IP (reference launch.py --node_ip)")
+    p.add_argument("--coordinator", default=None,
+                   help="coordination-service address host:port "
+                        "(default: node_ip with a free port, single-node)")
+    p.add_argument("--started_port", type=int, default=None,
+                   help="base port for PADDLE_TRAINER_ENDPOINTS")
+    p.add_argument("--backend", default=None,
+                   help="pin jax platform in workers (e.g. 'cpu' for the "
+                        "TestDistBase localhost pattern)")
+    p.add_argument("--local_devices_per_proc", type=int, default=None,
+                   help="virtual host devices per process (CPU backend)")
+    p.add_argument("--log_dir", default=None,
+                   help="write per-worker logs to LOG_DIR/workerlog.N")
+    p.add_argument("training_script", help="the script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(args) -> int:
+    n = args.nproc_per_node
+    coordinator = args.coordinator or f"{args.node_ip}:{_free_port()}"
+    base_port = args.started_port or _free_port()
+    endpoints = [f"{args.node_ip}:{base_port + i}" for i in range(n)]
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs, logs = [], []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(n),
+            "PADDLE_COORDINATOR": coordinator,
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "TRAINING_ROLE": "TRAINER",
+        })
+        if args.backend:
+            env["PADDLE_DIST_BACKEND"] = args.backend
+        if args.local_devices_per_proc:
+            env["PADDLE_LOCAL_DEVICES"] = str(args.local_devices_per_proc)
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+            logs.append(out)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out, stderr=out))
+
+    rc = 0
+    try:
+        alive = set(range(n))
+        while alive:
+            for i in list(alive):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                alive.discard(i)
+                if r != 0:
+                    rc = r
+                    # one worker died: the pod step can never complete — tear
+                    # the job down (reference launch.py terminate_procs)
+                    for j in alive:
+                        procs[j].send_signal(signal.SIGTERM)
+                    deadline = time.time() + 10
+                    for j in alive:
+                        try:
+                            procs[j].wait(max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            procs[j].kill()
+                    alive.clear()
+            time.sleep(0.1)
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    sys.exit(launch(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -232,20 +232,41 @@ class PServerRuntime:
         return np.asarray(v)
 
     # -- event loop ----------------------------------------------------------
+    def _signal_shutdown(self):
+        """Set the flag, then poke the listen socket: closing an fd does NOT
+        wake a thread blocked in accept() on Linux, so serve() is nudged with
+        a throwaway connection instead."""
+        self._shutdown.set()
+        import socket as _socket
+
+        try:
+            s = _socket.create_connection(_parse_ep(self.endpoint), timeout=1.0)
+            s.close()
+        except OSError:
+            pass
+
     def serve(self):
         listener = Listener(_parse_ep(self.endpoint), authkey=_AUTHKEY)
         threads = []
         while not self._shutdown.is_set():
             try:
-                listener._listener._socket.settimeout(1.0)
                 conn = listener.accept()
+            except (OSError, EOFError):
+                if self._shutdown.is_set():
+                    break
+                raise  # a healthy listener doesn't fail accept — surface it
             except Exception:
-                continue
+                continue  # auth failure from a stray client: keep serving
+            if self._shutdown.is_set():
+                break
             t = threading.Thread(target=self._client_loop, args=(conn,),
                                  daemon=True)
             t.start()
             threads.append(t)
-        listener.close()
+        try:
+            listener.close()
+        except OSError:
+            pass
 
     def _client_loop(self, conn):
         while not self._shutdown.is_set():
@@ -281,7 +302,7 @@ class PServerRuntime:
                             self._barriers_seen = set()
                     conn.send(("ok", None))
                     if done:
-                        self._shutdown.set()
+                        self._signal_shutdown()
                         return
                 else:
                     conn.send(("err", f"unknown op {msg['op']}"))

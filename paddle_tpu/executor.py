@@ -133,6 +133,9 @@ class _Compiled:
         self.ro_names = ro_names
         self.rw_names = rw_names
         self.fetch_names = fetch_names
+        # set when the mesh spans multiple processes: (feed, ro, rw)
+        # NamedShardings used to lift host values to global arrays
+        self.global_shardings = None
 
 
 def _has_host_ops(block) -> bool:
@@ -376,6 +379,27 @@ def _run_ops_traced(block, env, key=None):
     return env
 
 
+def _spans_processes(mesh) -> bool:
+    """True when the mesh covers devices of more than one JAX process (a
+    multi-host pod, or the launcher's localhost multi-process CPU job)."""
+    return mesh is not None and len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def _to_global(v, sharding):
+    """Place one host/local value as a global array over a multi-process mesh.
+
+    Feeds carry this process's shard of the global batch (the launcher's
+    per-trainer data split, reference launch.py env contract); state is
+    replicated, so every process supplies the full value. Both cases are
+    exactly `jax.make_array_from_process_local_data`'s contract.
+    """
+    if isinstance(v, jax.Array):
+        if v.sharding.device_set == sharding.device_set:
+            return v  # already global on this mesh
+        v = np.asarray(v)  # single-device/local array: re-place globally
+    return jax.make_array_from_process_local_data(sharding, np.asarray(v))
+
+
 class Executor:
     """Reference executor.py:295 contract: run(program, feed, fetch_list)."""
 
@@ -476,6 +500,13 @@ class Executor:
 
         ro_vals = tuple(self._fetch_state(scope, n) for n in comp.ro_names)
         rw_vals = tuple(self._fetch_state(scope, n) for n in comp.rw_names)
+        if comp.global_shardings is not None:
+            # multi-process mesh: feeds are this process's batch shard, state
+            # is replicated — lift everything to global arrays
+            feed_sh, ro_sh, rw_sh = comp.global_shardings
+            feed_vals = [_to_global(v, s) for v, s in zip(feed_vals, feed_sh)]
+            ro_vals = tuple(_to_global(v, s) for v, s in zip(ro_vals, ro_sh))
+            rw_vals = tuple(_to_global(v, s) for v, s in zip(rw_vals, rw_sh))
         scope._run_counter += 1
         key = jax.random.PRNGKey(program.random_seed or 0)
         key = jax.random.fold_in(key, scope._run_counter)
@@ -618,10 +649,19 @@ class Executor:
             jfn = jax.jit(sfn, donate_argnums=(2,))
             comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
             comp.extra_w = extra_w
+            if _spans_processes(mesh):
+                from jax.sharding import NamedSharding
+
+                comp.global_shardings = (
+                    tuple(NamedSharding(mesh, _feed_spec(n)) for n in feed_names),
+                    tuple(NamedSharding(mesh, P()) for _ in ro_names),
+                    tuple(NamedSharding(mesh, P()) for _ in rw_names),
+                )
             return comp
 
         fn = _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names)
         jit_kwargs: dict = {"donate_argnums": (2,)}
+        in_sh = None
         if mesh is not None:
             from .parallel.sharding import build_shardings
 
@@ -633,4 +673,6 @@ class Executor:
         jfn = jax.jit(fn, **jit_kwargs)
         comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
         comp.extra_w = extra_w
+        if in_sh is not None and _spans_processes(mesh):
+            comp.global_shardings = in_sh[:3]
         return comp

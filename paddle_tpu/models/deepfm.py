@@ -25,7 +25,7 @@ def deepfm(
     hidden_sizes=(400, 400, 400),
     is_sparse: bool = True,
 ):
-    """Build DeepFM; returns (avg_loss, auc_or_none, predict, feed_names)."""
+    """Build DeepFM; returns (avg_loss, predict, feed_names)."""
     sparse_ids = T.data(name="sparse_ids", shape=[n_fields], dtype="int64")
     dense_x = T.data(name="dense_x", shape=[n_dense], dtype="float32")
     label = T.data(name="label", shape=[1], dtype="float32")

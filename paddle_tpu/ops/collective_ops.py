@@ -37,7 +37,17 @@ def _axis(ctx: ExecContext):
 
 def _allreduce(red):
     def compute(ctx: ExecContext):
+        from ..core.selected_rows import is_selected_rows
+
         x = ctx.input("X")
+        if is_selected_rows(x):
+            # SelectedRows grads belong to the pserver path (sparse send);
+            # psum would sum row INDICES across ranks — reject loudly instead
+            raise TypeError(
+                f"c_allreduce_{red}: SelectedRows gradients cannot ride a "
+                "collective allreduce — use the parameter-server path "
+                "(DistributeTranspiler) for is_sparse=True embeddings, or "
+                "build the model with is_sparse=False for collective mode")
         axis = _axis(ctx)
         if axis is None:
             return {"Out": x}  # GSPMD regime: partitioner owns the reduction

@@ -30,6 +30,11 @@ def conv2d(ctx: ExecContext):
     p = _pair(ctx.attr("paddings", [0, 0]))
     d = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1)
+    # No preferred_element_type=f32 + astype pair here: the TPU MXU already
+    # accumulates bf16 convs in fp32 internally, and the astype's transpose
+    # rule would hand lax's conv grad an fp32 cotangent against bf16 operands
+    # (lax.conv_general_dilated requires matching dtypes), breaking AMP
+    # backward passes.
     out = jax.lax.conv_general_dilated(
         x,
         w,
@@ -38,8 +43,7 @@ def conv2d(ctx: ExecContext):
         rhs_dilation=d,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype)
+    )
     return {"Output": out}
 
 
@@ -402,9 +406,7 @@ def lookup_table_grad(ctx: ExecContext):
     return {"W@GRAD": dense}
 
 
-from .registry import _REGISTRY as _REG  # noqa: E402
-
-_REG["lookup_table_v2_grad"] = _REG["lookup_table_grad"]
+register_grad_compute("lookup_table_v2")(lookup_table_grad)
 
 
 @register_op("accuracy", grad="none")

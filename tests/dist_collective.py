@@ -1,0 +1,77 @@
+"""Collective-mode distributed runner (reference unittests/dist_mnist.py with
+DistributedStrategy collective, driven by TestDistBase._run_cluster:442): run
+under `python -m paddle_tpu.distributed.launch`, each process trains on its
+batch shard over a global mesh; with one process it is the local baseline.
+
+usage: dist_collective.py OUT_NPZ
+"""
+import sys
+
+from paddle_tpu.distributed import init_parallel_env
+
+# join the coordination service BEFORE any jax compute (multi-process CPU
+# needs the gloo collectives client wired into backend creation)
+penv = init_parallel_env(backend="cpu", local_device_count=1)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+from paddle_tpu.incubate.fleet.base import PaddleCloudRoleMaker, fleet  # noqa: E402
+
+STEPS = 5
+FULL_BATCH = 32
+
+
+def build():
+    x = L.data(name="x", shape=[16], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    h = L.fc(x, size=64, act="relu")
+    pred = L.fc(h, size=1)
+    return L.mean(L.square_error_cost(pred, y))
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((FULL_BATCH, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 1)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def main():
+    out = sys.argv[1]
+    if penv.world_size > 1:
+        out = f"{out}.r{penv.rank}.npz"
+    fleet.init(PaddleCloudRoleMaker())
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = 7
+    startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            loss = build()
+            opt = fleet.distributed_optimizer(pt.optimizer.SGD(0.1))
+            opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(startup)
+
+    compiled = fleet.compiled_program(main_p)
+    x, y = full_data()
+    shard = FULL_BATCH // penv.world_size
+    lo = penv.rank * shard
+    xs, ys = x[lo:lo + shard], y[lo:lo + shard]
+    for _ in range(STEPS):
+        (lv,) = exe.run(compiled, feed={"x": xs, "y": ys},
+                        fetch_list=[loss.name])
+
+    vals = {
+        p.name: np.asarray(pt.global_scope().find_var(p.name))
+        for p in main_p.all_parameters()
+    }
+    vals["__last_loss__"] = np.asarray(lv)
+    np.savez(out, **vals)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Launcher + multi-process collective training (reference
+unittests/test_dist_base.py:442 TestDistBase pattern, collective/NCCL2 mode):
+`python -m paddle_tpu.distributed.launch` over 2 localhost CPU processes must
+reproduce the single-process full-batch parameter trajectory."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+_SCRIPT = os.path.join(_DIR, "dist_collective.py")
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the conftest pins XLA_FLAGS for the in-process suite; workers provision
+    # their own device count via init_parallel_env
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    env.pop("PADDLE_TRAINER_ID", None)
+    return env
+
+
+def test_launch_two_process_collective_matches_local(tmp_path):
+    local_out = str(tmp_path / "local.npz")
+    p = subprocess.run(
+        [sys.executable, _SCRIPT, local_out],
+        env=_env(), capture_output=True, timeout=300)
+    assert p.returncode == 0, p.stderr.decode()[-3000:]
+
+    log_dir = str(tmp_path / "log")
+    dist_out = str(tmp_path / "dist")  # each rank writes dist.r{rank}.npz
+    p = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--local_devices_per_proc", "1", "--log_dir", log_dir,
+         _SCRIPT, dist_out],
+        env=_env(), cwd=_REPO, capture_output=True, timeout=300)
+    logs = ""
+    for i in range(2):
+        f = os.path.join(log_dir, f"workerlog.{i}")
+        if os.path.exists(f):
+            with open(f) as fh:
+                logs += f"--- workerlog.{i}\n" + fh.read()[-3000:]
+    assert p.returncode == 0, logs + p.stderr.decode()[-2000:]
+
+    local = np.load(local_out)
+    r0 = np.load(dist_out + ".r0.npz")
+    r1 = np.load(dist_out + ".r1.npz")
+    for k in local.files:
+        if k == "__last_loss__":
+            continue
+        np.testing.assert_allclose(
+            local[k], r0[k], rtol=1e-4, atol=1e-5,
+            err_msg=f"param {k} diverged from local baseline")
+        np.testing.assert_allclose(
+            r0[k], r1[k], rtol=1e-6, atol=1e-7,
+            err_msg=f"ranks disagree on param {k}")
