@@ -26,7 +26,9 @@ from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import transpiler  # noqa: F401
 from . import distributed  # noqa: F401
+from . import inference  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .pyreader import DataLoader, PyReader  # noqa: F401
 batch = reader.batch  # paddle.batch alias

@@ -545,6 +545,86 @@ class Executor:
             return [np.asarray(x) for x in fetches]
         return list(fetches)
 
+    def train_from_dataset(
+        self,
+        program=None,
+        dataset=None,
+        scope: Scope | None = None,
+        thread: int = 0,
+        debug: bool = False,
+        fetch_list=None,
+        fetch_info=None,
+        print_period: int = 100,
+    ):
+        """Consume a Dataset end-to-end (reference executor.py:894 +
+        Executor::RunFromDataset, executor.cc:142).
+
+        The reference spins `thread` device workers each running the program
+        over its own data feed (trainer.h MultiTrainer, device_worker.h
+        HogwildWorker). On TPU one XLA stream consumes every batch — host
+        threads inside the Dataset overlap file parse/shuffle with device
+        steps, which is where the parallelism actually helps here.
+        """
+        if dataset is None:
+            raise RuntimeError("dataset is need and should be initialized")
+        if thread:
+            # reference semantics: min(dataset thread_num, thread) — but an
+            # unconfigured dataset (thread_num=1 default) takes the explicit
+            # request rather than silently clamping it to 1
+            dataset.set_thread(
+                min(dataset.thread_num, thread)
+                if dataset.thread_num > 1 else thread)
+        dataset._prepare_to_run()
+        try:
+            self._run_from_dataset(
+                program, dataset, scope, debug, fetch_list, fetch_info,
+                print_period)
+        finally:
+            dataset._finish_to_run()
+
+    def infer_from_dataset(
+        self,
+        program=None,
+        dataset=None,
+        scope: Scope | None = None,
+        thread: int = 0,
+        debug: bool = False,
+        fetch_list=None,
+        fetch_info=None,
+        print_period: int = 100,
+    ):
+        """reference executor.py:817 — identical loop; the program itself has
+        no optimizer ops, so nothing updates."""
+        self.train_from_dataset(
+            program, dataset, scope, thread, debug, fetch_list, fetch_info,
+            print_period)
+
+    def _run_from_dataset(self, program, dataset, scope, debug, fetch_list,
+                          fetch_info, print_period):
+        import time as _time
+
+        fetch_list = fetch_list or []
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in fetch_list]
+        if fetch_info is not None and len(fetch_info) != len(names):
+            raise ValueError(
+                f"fetch_info has {len(fetch_info)} entries for "
+                f"{len(names)} fetch_list variables")
+        labels = list(fetch_info or names)
+        t0 = _time.perf_counter()
+        n_batches = 0
+        for feed in dataset._iter_batches():
+            outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            n_batches += 1
+            if (debug or names) and n_batches % print_period == 0:
+                msg = ", ".join(
+                    f"{lbl}: {np.asarray(o).reshape(-1)[:8]}"
+                    for lbl, o in zip(labels, outs))
+                dt = _time.perf_counter() - t0
+                print(f"batch {n_batches} ({n_batches / dt:.1f} batch/s) "
+                      f"{msg}", flush=True)
+
     def close(self):
         """Notify pservers this trainer is done (reference executor.cc:95
         SendComplete via exe.close())."""

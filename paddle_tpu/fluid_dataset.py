@@ -1,0 +1,283 @@
+"""Dataset runtime: QueueDataset / InMemoryDataset + DatasetFactory.
+
+TPU-native re-design of the reference's Dataset stack:
+  * python API (/root/reference/python/paddle/fluid/dataset.py:21
+    DatasetFactory, :63 DatasetBase, :269 InMemoryDataset, :613 QueueDataset)
+  * C++ runtime (/root/reference/paddle/fluid/framework/data_set.h:41
+    DatasetImpl, :212 MultiSlotDataset; data_feed.h MultiSlotDataFeed)
+
+Same contract — slot-based text files, multi-threaded ingest, local/global
+shuffle, consumed by `exe.train_from_dataset` — with the runtime re-shaped
+for TPU:
+  * parsing runs in the native C parser (paddle_tpu/native) on host threads;
+    samples become padded fixed-width arrays at ingest (the LoD->padding
+    design), so batches land on the device as static-shape buffers;
+  * there are no per-thread device workers: one XLA stream consumes batches
+    (device_worker.h's HogwildWorker parallelism only makes sense for CPU
+    kernels); host threads overlap parse/shuffle with device steps instead;
+  * global_shuffle partitions by sample hash across trainers — every trainer
+    loads the shared filelist and keeps hash(i) % nranks == rank, which
+    reproduces the reference's post-condition (each sample on exactly one
+    trainer, seeded random order) without a fleet send path.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "QueueDataset", "InMemoryDataset", "MultiSlotDataset"]
+
+
+class DatasetFactory:
+    """reference dataset.py:21 — create_dataset("QueueDataset"|"InMemoryDataset")."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        try:
+            cls = {
+                "QueueDataset": QueueDataset,
+                "InMemoryDataset": InMemoryDataset,
+                "MultiSlotDataset": QueueDataset,  # C++ name accepted too
+            }[datafeed_class]
+        except KeyError:
+            raise ValueError(
+                f"datafeed class {datafeed_class} does not exist")
+        return cls()
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: list[str] = []
+        self.use_vars = []
+        self.pipe_command = None  # accepted for API parity; not a hot path
+        self.drop_last = False
+        self._seed = 0
+
+    # -- reference setters ---------------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        """Declare the slot layout: one slot per var, width = prod(var.shape)."""
+        self.use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command: str):
+        self.pipe_command = pipe_command
+
+    def set_drop_last(self, drop_last: bool):
+        self.drop_last = bool(drop_last)
+
+    # -- slot layout ---------------------------------------------------------
+    def _widths(self):
+        ws = []
+        for v in self.use_vars:
+            shape = [d for d in v.shape if d not in (-1, None)]
+            w = 1
+            for d in shape:
+                w *= int(d)
+            ws.append(max(1, w))
+        return ws
+
+    def _split_batch(self, flat: np.ndarray) -> dict:
+        """[B, sum(widths)] float64 -> {var name: [B, *shape] typed array}."""
+        feed = {}
+        off = 0
+        for v, w in zip(self.use_vars, self._widths()):
+            part = flat[:, off:off + w]
+            off += w
+            shape = [d for d in v.shape if d not in (-1, None)]
+            arr = part.reshape([part.shape[0]] + [int(d) for d in shape])
+            feed[v.name] = arr.astype(v.np_dtype, copy=False)
+        return feed
+
+    def _parse_file(self, path: str) -> np.ndarray:
+        from .native import parse_multislot_file
+
+        return parse_multislot_file(path, self._widths())
+
+    # executor hooks (reference _prepare_to_run/_finish_to_run)
+    def _prepare_to_run(self):
+        if not self.use_vars:
+            raise RuntimeError("Dataset.set_use_var must be called first")
+
+    def _finish_to_run(self):
+        pass
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (reference dataset.py:613): files are parsed by a
+    thread pool during iteration; nothing is retained afterwards."""
+
+    def _iter_batches(self):
+        self._prepare_to_run()
+        files = queue.Queue()
+        for f in self.filelist:
+            files.put(f)
+        out: queue.Queue = queue.Queue(maxsize=max(4, 2 * self.thread_num))
+        n_workers = min(self.thread_num, max(1, len(self.filelist)))
+        errors: list[BaseException] = []
+        stop = threading.Event()  # consumer abandoned the generator
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    out.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                while not stop.is_set():
+                    try:
+                        path = files.get_nowait()
+                    except queue.Empty:
+                        return
+                    data = self._parse_file(path)
+                    for i in range(0, len(data), self.batch_size):
+                        chunk = data[i:i + self.batch_size]
+                        if self.drop_last and len(chunk) < self.batch_size:
+                            continue
+                        if not _put(chunk):
+                            return
+            except BaseException as e:  # propagate into the consumer
+                errors.append(e)
+            finally:
+                _put(None) or out.put(None)  # sentinel must always land
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(n_workers)]
+        for t in threads:
+            t.start()
+        finished = 0
+        try:
+            while finished < n_workers:
+                item = out.get()
+                if item is None:
+                    finished += 1
+                    continue
+                yield self._split_batch(item)
+        finally:
+            # early exit (exe.run raised / caller broke out): unblock workers
+            stop.set()
+            while finished < n_workers:
+                if out.get() is None:
+                    finished += 1
+        if errors:
+            raise errors[0]
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py:269 — load once, shuffle in memory, iterate many
+    epochs; global_shuffle partitions samples across fleet trainers."""
+
+    def __init__(self):
+        super().__init__()
+        self._data: np.ndarray | None = None
+
+    def load_into_memory(self):
+        self._prepare_to_run()
+        parts = []
+        files = queue.Queue()
+        for f in self.filelist:
+            files.put(f)
+        lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def worker():
+            try:
+                while True:
+                    try:
+                        path = files.get_nowait()
+                    except queue.Empty:
+                        return
+                    d = self._parse_file(path)
+                    with lock:
+                        parts.append(d)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(min(self.thread_num,
+                                      max(1, len(self.filelist))))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        self._data = (np.concatenate(parts) if parts
+                      else np.zeros((0, int(sum(self._widths())))))
+
+    def preload_into_memory(self):
+        self._preload_error: BaseException | None = None
+
+        def _load():
+            try:
+                self.load_into_memory()
+            except BaseException as e:
+                self._preload_error = e
+
+        self._preload = threading.Thread(target=_load)
+        self._preload.start()
+
+    def wait_preload_done(self):
+        self._preload.join()
+        if self._preload_error is not None:
+            raise self._preload_error
+
+    def local_shuffle(self):
+        if self._data is None:
+            raise RuntimeError("call load_into_memory() before local_shuffle()")
+        rng = np.random.default_rng(self._seed)
+        self._seed += 1
+        rng.shuffle(self._data)
+
+    def global_shuffle(self, fleet=None, thread_num: int | None = None):
+        """Keep this trainer's hash partition of the (shared) sample set,
+        shuffled. Matches the reference post-condition when every trainer
+        loaded the same filelist (data_set.cc GlobalShuffle's send-by-hash)."""
+        if self._data is None:
+            raise RuntimeError("call load_into_memory() before global_shuffle()")
+        rank, nranks = 0, 1
+        if fleet is not None:
+            rank, nranks = fleet.worker_index(), fleet.worker_num()
+        rng = np.random.default_rng(self._seed)
+        self._seed += 1
+        perm = rng.permutation(len(self._data))
+        if nranks > 1:
+            perm = perm[perm % nranks == rank]
+        self._data = self._data[perm]
+
+    def release_memory(self):
+        self._data = None
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        n = 0 if self._data is None else len(self._data)
+        return n  # per-trainer count; fleet-wide sum needs a collective
+
+    get_shuffle_data_size = get_memory_data_size
+
+    def _iter_batches(self):
+        self._prepare_to_run()
+        if self._data is None:
+            raise RuntimeError(
+                "InMemoryDataset: call load_into_memory() before training")
+        for i in range(0, len(self._data), self.batch_size):
+            chunk = self._data[i:i + self.batch_size]
+            if self.drop_last and len(chunk) < self.batch_size:
+                continue
+            yield self._split_batch(chunk)
+
+
+MultiSlotDataset = QueueDataset

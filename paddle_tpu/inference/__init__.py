@@ -1,0 +1,166 @@
+"""Inference Predictor API.
+
+TPU-native re-design of the reference's inference engine surface:
+  * PaddlePredictor / NativeConfig
+    (/root/reference/paddle/fluid/inference/api/paddle_api.h:219 Run contract,
+    :287 NativeConfig; api_impl.h:34 NativePaddlePredictor)
+  * AnalysisPredictor + AnalysisConfig
+    (analysis_predictor.h:46, paddle_analysis_config.h) — the reference runs
+    ~20 IR passes (fusion, fp16, TensorRT subgraphs) before execution.
+
+Here the "analysis" stage IS the XLA compiler: the loaded program lowers to
+one jitted computation per input signature (fusion, layout, constant folding
+come from XLA, not hand-written passes). What remains of AnalysisConfig are
+the knobs with real TPU meaning — bf16 weight/computation precision (the
+float16 inference mode the reference benchmarks in
+paddle/contrib/float16/float16_transpiler.py) and buffer donation.
+
+Contract: predictor.run([named numpy arrays]) -> [named numpy arrays], plus
+a zero-copy-ish dict API (run_dict) for Python callers.
+"""
+from __future__ import annotations
+
+import copy
+import os
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+__all__ = [
+    "PaddleTensor",
+    "NativeConfig",
+    "AnalysisConfig",
+    "create_paddle_predictor",
+    "Predictor",
+]
+
+
+@dataclass
+class PaddleTensor:
+    """reference paddle_api.h:145 — a named ndarray (LoD collapses to
+    padding per the framework-wide design)."""
+
+    name: str
+    data: Any = None
+
+    @property
+    def shape(self):
+        return list(np.asarray(self.data).shape)
+
+
+@dataclass
+class NativeConfig:
+    """reference paddle_api.h:287 (model paths + device). `model_dir` expects
+    the save_inference_model layout."""
+
+    model_dir: str = ""
+    prog_file: str = ""
+    params_file: str = ""
+    use_tpu: bool = True  # device selection is jax's; kept for API parity
+
+
+@dataclass
+class AnalysisConfig(NativeConfig):
+    """reference paddle_analysis_config.h — knobs that survive the XLA
+    redesign. enable_bf16: cast params + compute to bfloat16 (the float16
+    inference mode of paddle/contrib/float16/, retargeted at TPU's native
+    dtype)."""
+
+    enable_bf16: bool = False
+    # no-op parity knobs: XLA always fuses/optimizes; donation is automatic
+    ir_optim: bool = True
+    memory_optim: bool = True
+    _extra: dict = field(default_factory=dict)
+
+    def switch_ir_optim(self, flag: bool = True):
+        self.ir_optim = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self.memory_optim = flag
+
+
+class Predictor:
+    """Executes a saved inference model (reference api_impl.h:34 /
+    analysis_predictor.h:46). One compile per input-shape signature, cached
+    by the Executor; repeated run() calls hit the cache."""
+
+    def __init__(self, config: NativeConfig):
+        from ..executor import Executor, Scope, scope_guard
+        from .. import io
+
+        self._config = config
+        self._exe = Executor()
+        self._scope = Scope()
+        with scope_guard(self._scope):
+            if config.model_dir:
+                prog, feeds, fetches = io.load_inference_model(
+                    config.model_dir, self._exe)
+            else:
+                prog, feeds, fetches = io.load_inference_model(
+                    os.path.dirname(config.prog_file) or ".", self._exe,
+                    model_filename=os.path.basename(config.prog_file),
+                    params_filename=(os.path.basename(config.params_file)
+                                     or None))
+        self._program = prog
+        self._feed_names = list(feeds)
+        self._fetch_names = [v if isinstance(v, str) else v.name
+                             for v in fetches]
+        if getattr(config, "enable_bf16", False):
+            self._to_bf16()
+
+    # -- reference Run() contract -------------------------------------------
+    def run(self, inputs: Sequence[PaddleTensor]) -> list[PaddleTensor]:
+        feed = {t.name: t.data for t in inputs}
+        outs = self.run_dict(feed)
+        return [PaddleTensor(name=n, data=o)
+                for n, o in zip(self._fetch_names, outs)]
+
+    def run_dict(self, feed: dict) -> list[np.ndarray]:
+        from ..executor import scope_guard
+
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"predictor missing feeds: {missing}")
+        with scope_guard(self._scope):
+            return self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+
+    def get_input_names(self) -> list[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> list[str]:
+        return list(self._fetch_names)
+
+    def clone(self) -> "Predictor":
+        """reference PaddlePredictor::Clone — share nothing mutable; params
+        are re-read from the model dir (jax arrays themselves are immutable,
+        but scope/compile-cache state is per-predictor)."""
+        return Predictor(copy.deepcopy(self._config))
+
+    # -- bf16 inference mode -------------------------------------------------
+    def _to_bf16(self):
+        """Cast float params and float compute to bf16 (float16_transpiler.py
+        contract, bf16 because that is the TPU-native half type)."""
+        import jax.numpy as jnp
+        import numpy as _np
+
+        from ..core.types import DType
+
+        for name in list(self._scope.var_names()):
+            v = self._scope.find_var(name)
+            arr = _np.asarray(v)
+            if arr.dtype == _np.float32:
+                self._scope.set_var(name, jnp.asarray(arr, jnp.bfloat16))
+        for block in self._program.blocks:
+            for var in block.vars.values():
+                if var.dtype == DType.FP32:
+                    var.dtype = DType.BF16
+            for op in block.ops:
+                if op.attrs.get("dtype") == DType.FP32:
+                    op.attrs["dtype"] = DType.BF16
+
+
+def create_paddle_predictor(config: NativeConfig) -> Predictor:
+    """reference paddle_api.h CreatePaddlePredictor<ConfigT>."""
+    return Predictor(config)
