@@ -121,10 +121,13 @@ class LayerHelper:
         if b is None:
             return input_var
         out = self.create_variable_for_type_inference(input_var.dtype)
+        # axis=-1 (trailing alignment): a [size] bias always lands on the
+        # last dim regardless of the input's build-time rank, which can
+        # differ from runtime rank inside control-flow sub-blocks
         self.append_op(
             "elementwise_add",
             inputs={"X": [input_var], "Y": [b]},
             outputs={"Out": [out]},
-            attrs={"axis": len(input_var.shape) - 1},
+            attrs={"axis": -1},
         )
         return out

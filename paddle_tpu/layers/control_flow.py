@@ -1,15 +1,17 @@
-"""Control-flow layers: While, cond, Switch, StaticRNN.
+"""Control-flow layers: While, cond, Switch, StaticRNN, DynamicRNN.
 
 Reference: /root/reference/python/paddle/fluid/layers/control_flow.py
-(While:698, Switch:1622, StaticRNN:318, ConditionalBlock:1471; DynamicRNN is
-LoD-based and intentionally absent — padded static_rnn + segment masks replace
-it, SURVEY.md §5 long-context notes)."""
+(While:698, Switch:1622, StaticRNN:318, DynamicRNN:1769,
+ConditionalBlock:1471). DynamicRNN here is the padding-based equivalent of
+the reference's LoD walker: full padded extent through one lax.scan, state
+frozen per row once t >= length (see the class docstring)."""
 from __future__ import annotations
 
 from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["While", "cond", "Switch", "StaticRNN", "less_than", "less_equal",
+__all__ = ["While", "cond", "Switch", "StaticRNN", "DynamicRNN",
+           "less_than", "less_equal",
            "greater_than", "greater_equal", "equal", "not_equal",
            "logical_and", "logical_or", "logical_not", "logical_xor"]
 
@@ -370,3 +372,145 @@ class _StaticRNNGuard:
         if exc_type is None:
             self.rnn._build(self.block)
         return False
+
+
+class DynamicRNN:
+    """Padding-based equivalent of the reference DynamicRNN
+    (control_flow.py:1769).
+
+    The reference walks LoD offsets, shrinking the batch as short sequences
+    finish. Ragged iteration defeats XLA, so this runs the full padded
+    [B, T, ...] extent through one lax.scan (StaticRNN) and freezes each
+    row's state once `t >= length`:
+      * memories stop updating (update_memory masks with t < length),
+      * step outputs beyond a row's length are zeroed.
+    Same observable semantics on the valid region, fixed shapes throughout.
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(x, length=lens)   # x: [B, T, D] batch-major
+            h = drnn.memory(init=h0)
+            h2 = L.fc([w, h], size=H, act="tanh")
+            drnn.update_memory(h, h2)
+            drnn.output(h2)
+        out = drnn()   # [B, T, H], padded tail zeroed
+    """
+
+    def __init__(self, name=None):
+        from . import nn as _nn  # local import; avoids a cycle at module load
+        from . import tensor as _tensor
+
+        self._nn = _nn
+        self._tensor = _tensor
+        self._rnn = StaticRNN(name=name)
+        self._length = None
+        self._t = None
+        self._in_step = False
+        self._output_ranks = []
+
+    def block(self):
+        return _DynamicRNNGuard(self)
+
+    # -- inside-block API ----------------------------------------------------
+    def _parent_block(self):
+        prog = default_main_program()
+        sub = prog.current_block()
+        return prog.blocks[sub.parent_idx]
+
+    def _parent_transpose(self, x: Variable):
+        """Append a batch-major -> time-major transpose to the PARENT block
+        (step_input is called inside the step sub-block, but the scan's
+        sequence operand must exist outside it)."""
+        parent = self._parent_block()
+        perm = [1, 0] + list(range(2, len(x.shape)))
+        shape = tuple(x.shape[i] for i in perm)
+        out = parent.create_var(
+            name=self._rnn.helper.name + f".tm{len(self._rnn._step_inputs)}",
+            shape=shape, dtype=x.dtype)
+        parent.append_op("transpose2", {"X": [x.name]}, {"Out": [out.name]},
+                         {"axis": perm})
+        return out
+
+    def step_input(self, x: Variable, length: Variable | None = None):
+        """x: batch-major [B, T, ...]; optional per-row valid length [B]."""
+        if not self._in_step:
+            raise RuntimeError("step_input must be called inside block()")
+        if x.shape[0] is None or x.shape[0] < 0:
+            raise ValueError(
+                "DynamicRNN.step_input needs a static batch size (got "
+                f"shape {x.shape}): set var.shape = (B, T, ...) before the "
+                "block — per-step layers infer parameter shapes from it")
+        if length is not None:
+            if self._length is not None:
+                raise ValueError("DynamicRNN already has a length input")
+            self._length = length
+            # per-step scalar time index, scanned alongside the data
+            parent = self._parent_block()
+            T = x.shape[1]
+            t_seq = parent.create_var(
+                name=self._rnn.helper.name + ".tseq", shape=(T,),
+                dtype="int64")
+            parent.append_op(
+                "range", {}, {"Out": [t_seq.name]},
+                {"start": 0.0, "end": float(T), "step": 1.0,
+                 "dtype": "int64"})
+            self._t_inner = self._rnn.step_input(t_seq)   # scalar per step
+            self._len_inner = length
+        return self._rnn.step_input(self._parent_transpose(x))
+
+    def memory(self, init: Variable):
+        return self._rnn.memory(init)
+
+    def update_memory(self, mem: Variable, new: Variable):
+        if self._length is not None:
+            live = self._nn.cast(
+                less_than(self._t_inner, self._len_inner), new.dtype)
+            for _ in range(len(mem.shape) - 1):
+                live = self._nn.unsqueeze(live, axes=[-1])
+            new = self._nn.elementwise_add(
+                self._nn.elementwise_mul(new, live),
+                self._nn.elementwise_mul(mem, 1.0 - live))
+        self._rnn.update_memory(mem, new)
+
+    def output(self, *outs):
+        for o in outs:
+            rank = len(o.shape)  # recorded pre-mask: the mask ops' build
+            # shapes can be unknown inside the sub-block
+            if self._length is not None:
+                live = self._nn.cast(
+                    less_than(self._t_inner, self._len_inner), o.dtype)
+                for _ in range(rank - 1):
+                    live = self._nn.unsqueeze(live, axes=[-1])
+                o = self._nn.elementwise_mul(o, live)
+            self._rnn.step_output(o)
+            self._output_ranks.append(rank)
+
+    def __call__(self):
+        outs = self._rnn()
+        outs = outs if isinstance(outs, list) else [outs]
+        # back to batch-major [B, T, ...]; rank from the recorded inner
+        # step outputs (outer build shapes may be unknown when inference
+        # failed inside the sub-block)
+        res = []
+        for o, inner_rank in zip(outs, self._output_ranks):
+            rank = inner_rank + 1
+            res.append(self._nn.transpose(
+                o, perm=[1, 0] + list(range(2, rank))))
+        return res[0] if len(res) == 1 else res
+
+
+class _DynamicRNNGuard:
+    def __init__(self, drnn: DynamicRNN):
+        self.d = drnn
+
+    def __enter__(self):
+        d = self.d
+        d._in_step = True
+        d._guard = d._rnn.step()
+        # pre-step plumbing happens lazily on first step_input
+        d._entered = d._guard.__enter__()
+        return d
+
+    def __exit__(self, exc_type, *a):
+        self.d._in_step = False
+        return self.d._guard.__exit__(exc_type, *a)

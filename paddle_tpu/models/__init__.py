@@ -8,5 +8,6 @@ Each builder appends to the current default main/startup programs (use
 from . import deepfm  # noqa: F401
 from . import mlp  # noqa: F401
 from . import resnet  # noqa: F401
+from . import seq2seq  # noqa: F401
 from . import transformer  # noqa: F401
 from . import word2vec  # noqa: F401

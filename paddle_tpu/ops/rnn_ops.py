@@ -1,0 +1,153 @@
+"""Recurrent cell + sequence-RNN ops.
+
+TPU-native re-design of the reference recurrent operators:
+  * gru_unit_op.h (one step; exact gate math reproduced below)
+  * gru_op.cc / dynamic_gru  -> `gru`: whole-sequence lax.scan (the
+    reference's LoD batch reordering becomes a scan over the padded time
+    axis; XLA keeps weights resident across steps)
+  * lstm_op.cc / dynamic_lstm -> `lstm`: same scan treatment
+
+Scans carry [B, H] state; matmuls inside the body hit the MXU per step. The
+reference's sequence->batch reorder machinery (math/sequence2batch.h) is
+unnecessary: padding already gives a rectangular [B, T, ...] layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ExecContext, register_op
+
+_ACTS = {
+    "identity": lambda x: x,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+}
+
+
+def _act(name):
+    try:
+        return _ACTS[str(name)]
+    except KeyError:
+        raise ValueError(f"unsupported activation '{name}'")
+
+
+def _gru_step(x_t, h_prev, weight, bias, act, gate_act, origin_mode):
+    """Exact gru_unit_op.h math: gates = x + b + h_prev @ W[:, :2H];
+    c = act(x_c + (r*h_prev) @ W[:, 2H:]); h = u*(c-h_prev)+h_prev."""
+    H = h_prev.shape[-1]
+    g = x_t
+    if bias is not None:
+        g = g + bias.reshape(1, 3 * H)
+    g = g.at[:, : 2 * H].add(h_prev @ weight[:, : 2 * H])
+    u = gate_act(g[:, :H])
+    r = gate_act(g[:, H: 2 * H])
+    r_h = r * h_prev
+    c_pre = g[:, 2 * H:] + r_h @ weight[:, 2 * H:]
+    c = act(c_pre)
+    if origin_mode:
+        h = c + u * (h_prev - c)
+    else:
+        h = u * (c - h_prev) + h_prev
+    gates = jnp.concatenate([u, r, c], axis=-1)
+    return h, r_h, gates
+
+
+@register_op("gru_unit")
+def gru_unit(ctx: ExecContext):
+    x = ctx.input("Input")          # [B, 3H] = x @ W_x (+ x bias)
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")          # [H, 3H]
+    b = ctx.input("Bias")
+    h, r_h, gates = _gru_step(
+        x, h_prev, w, b,
+        _act(ctx.attr("activation", "tanh")),
+        _act(ctx.attr("gate_activation", "sigmoid")),
+        bool(ctx.attr("origin_mode", False)))
+    return {"Hidden": h, "ResetHiddenPrev": r_h, "Gate": gates}
+
+
+@register_op("gru")
+def gru(ctx: ExecContext):
+    """Whole-sequence GRU (reference gru_op.cc / layers.dynamic_gru).
+    Input [B, T, 3H]; optional H0 [B, H]; Weight [H, 3H]; Bias [1, 3H].
+    Output Hidden [B, T, H]."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    b = ctx.input("Bias")
+    H = w.shape[0]
+    B = x.shape[0]
+    h0 = ctx.input("H0")
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    act = _act(ctx.attr("activation", "tanh"))
+    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
+    origin = bool(ctx.attr("origin_mode", False))
+    reverse = bool(ctx.attr("is_reverse", False))
+
+    def step(h, x_t):
+        h2, _, _ = _gru_step(x_t, h, w, b, act, gate_act, origin)
+        return h2, h2
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, 3H]
+    _, hs = jax.lax.scan(step, h0, xs, reverse=reverse)
+    return {"Hidden": jnp.swapaxes(hs, 0, 1)}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx: ExecContext):
+    """One LSTM step (reference lstm_unit_op.h:63-71): X [B, 4H] pre-projected
+    gates in the reference's (i, f, o, g) layout, C_prev [B, H]."""
+    x = ctx.input("X")
+    c_prev = ctx.input("C_prev")
+    H = c_prev.shape[-1]
+    forget_bias = float(ctx.attr("forget_bias", 0.0))
+    i = jax.nn.sigmoid(x[:, :H])
+    f = jax.nn.sigmoid(x[:, H: 2 * H] + forget_bias)
+    o = jax.nn.sigmoid(x[:, 2 * H: 3 * H])
+    g = jnp.tanh(x[:, 3 * H:])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("lstm")
+def lstm(ctx: ExecContext):
+    """Whole-sequence LSTM (reference lstm_op.cc / layers.dynamic_lstm).
+    Input [B, T, 4H] pre-projected; Weight [H, 4H] recurrent weights; Bias
+    [1, 4H]. Gate order (c_hat, i, f, o) follows the reference's
+    Weight = {W_ch, W_ih, W_fh, W_oh} layout (lstm_op.cc:125)."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    b = ctx.input("Bias")
+    H = w.shape[0]
+    B = x.shape[0]
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), x.dtype)
+    cand_act = _act(ctx.attr("candidate_activation", "tanh"))
+    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
+    cell_act = _act(ctx.attr("cell_activation", "tanh"))
+    reverse = bool(ctx.attr("is_reverse", False))
+
+    def step(carry, x_t):
+        h, c = carry
+        g = x_t + h @ w
+        if b is not None:
+            g = g + b.reshape(1, 4 * H)
+        c_hat = cand_act(g[:, :H])
+        i = gate_act(g[:, H: 2 * H])
+        f = gate_act(g[:, 2 * H: 3 * H])
+        o = gate_act(g[:, 3 * H:])
+        c2 = f * c + i * c_hat
+        h2 = o * cell_act(c2)
+        return (h2, c2), (h2, c2)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}

@@ -1,0 +1,253 @@
+"""Sequence ops under the LoD->padding design, plus beam search.
+
+TPU-native re-design of the reference's sequence operator family
+(/root/reference/paddle/fluid/operators/sequence_ops/, 47 files) and beam
+search (operators/beam_search_op.cc, beam_search_decode_op.cc).
+
+The reference represents ragged batches as LoD tensors: one flat value tensor
+plus offset tables, and every sequence op walks the offsets. On TPU ragged
+shapes defeat XLA, so the whole family is re-based on the framework-wide
+padding contract (framework.py): a batch is [B, T, ...] plus an explicit
+`length` int tensor [B]; masks replace offset walks. Each op below names the
+reference op whose *semantics on the valid region* it reproduces.
+
+Beam search keeps the reference's per-step op contract — `beam_search` inside
+a While block selecting beam_size continuations, `beam_search_decode`
+backtracking parent pointers — but on fixed [batch*beam, ...] arrays (a beam
+is a static axis; finished beams are frozen on end_id rather than shrinking
+the LoD, which is what makes the loop jittable as one lax.while_loop).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_op
+
+_NEG_INF = -1e9
+
+
+def _lengths(ctx, time_extent, batch):
+    ln = ctx.input("Length")
+    if ln is None:
+        return jnp.full((batch,), time_extent, dtype=jnp.int32)
+    return ln.reshape(-1).astype(jnp.int32)
+
+
+def _time_mask(lengths, maxlen, dtype=jnp.float32):
+    t = jnp.arange(maxlen, dtype=jnp.int32)
+    return (t[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_mask", grad="none")
+def sequence_mask(ctx: ExecContext):
+    """reference sequence_ops/sequence_mask_op.cc: lengths -> [B, maxlen]."""
+    x = ctx.input("X").reshape(-1).astype(jnp.int32)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError(
+            "sequence_mask requires a static maxlen attr under XLA "
+            "(data-dependent output shapes cannot be jitted)")
+    from ..core.types import np_dtype
+
+    dt = np_dtype(ctx.attr("out_dtype", "int64"))
+    t = jnp.arange(int(maxlen), dtype=jnp.int32)
+    return {"Y": (t[None, :] < x[:, None]).astype(dt)}
+
+
+@register_op("sequence_pad")
+def sequence_pad(ctx: ExecContext):
+    """reference sequence_pad_op.cc: keep the valid prefix, set the tail to
+    pad_value. Input is already dense [B, T, ...] + Length."""
+    x, pad = ctx.input("X"), ctx.input("PadValue")
+    ln = _lengths(ctx, x.shape[1], x.shape[0])
+    mask = _time_mask(ln, x.shape[1], jnp.bool_)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(mask, x, jnp.asarray(pad, x.dtype))
+    return {"Out": out, "Length": ln.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(ctx: ExecContext):
+    """reference sequence_unpad_op.cc — under padding the dense layout stays;
+    the tail is zeroed so downstream masked ops see a canonical form."""
+    x = ctx.input("X")
+    ln = _lengths(ctx, x.shape[1], x.shape[0])
+    mask = _time_mask(ln, x.shape[1], x.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return {"Out": x * mask}
+
+
+@register_op("sequence_pool")
+def sequence_pool(ctx: ExecContext):
+    """reference sequence_pool_op.cc: SUM/AVERAGE/SQRT/MAX/LAST/FIRST over
+    the valid region of [B, T, D]."""
+    x = ctx.input("X")
+    pooltype = str(ctx.attr("pooltype", "SUM")).upper()
+    B, T = x.shape[0], x.shape[1]
+    ln = _lengths(ctx, T, B)
+    mask = _time_mask(ln, T, x.dtype).reshape((B, T) + (1,) * (x.ndim - 2))
+    if pooltype == "SUM":
+        out = (x * mask).sum(axis=1)
+    elif pooltype == "AVERAGE":
+        out = (x * mask).sum(axis=1) / jnp.maximum(
+            ln.astype(x.dtype), 1).reshape((B,) + (1,) * (x.ndim - 2))
+    elif pooltype == "SQRT":
+        out = (x * mask).sum(axis=1) / jnp.sqrt(jnp.maximum(
+            ln.astype(x.dtype), 1)).reshape((B,) + (1,) * (x.ndim - 2))
+    elif pooltype == "MAX":
+        out = jnp.where(mask.astype(bool), x, _NEG_INF).max(axis=1)
+    elif pooltype == "LAST":
+        idx = jnp.maximum(ln - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif pooltype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype '{pooltype}'")
+    return {"Out": out}
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ctx: ExecContext):
+    """reference sequence_reverse_op.h: reverse each valid prefix in place;
+    padding stays at the tail."""
+    x = ctx.input("X")
+    B, T = x.shape[0], x.shape[1]
+    ln = _lengths(ctx, T, B)
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.where(t < ln[:, None], ln[:, None] - 1 - t, t)
+    return {"Y": jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)}
+
+
+@register_op("sequence_expand")
+def sequence_expand(ctx: ExecContext):
+    """reference sequence_expand_op.cc with ref_level=-1 collapsed to the
+    padding contract: repeat each row of X `Times` times along a new/beam
+    axis. X [B, ...] + Times scalar attr -> [B*times, ...] (row-major repeat,
+    the beam-search layout)."""
+    x = ctx.input("X")
+    times = int(ctx.attr("times", 1))
+    return {"Out": jnp.repeat(x, times, axis=0)}
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx: ExecContext):
+    """reference sequence_softmax_op.cc: softmax over each valid region of
+    [B, T] (padding gets probability 0)."""
+    x = ctx.input("X")
+    B, T = x.shape[0], x.shape[1]
+    ln = _lengths(ctx, T, B)
+    mask = _time_mask(ln, T, jnp.bool_)
+    z = jnp.where(mask, x, _NEG_INF)
+    p = jax.nn.softmax(z, axis=1)
+    return {"Out": jnp.where(mask, p, 0.0)}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx: ExecContext):
+    """reference sequence_concat_op.cc on padded operands: concat along
+    time. Valid regions are assumed left-aligned (canonical padded form)."""
+    xs = ctx.inputs("X")
+    return {"Out": jnp.concatenate([x for x in xs if x is not None], axis=1)}
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+
+@register_op("beam_search", grad="none")
+def beam_search(ctx: ExecContext):
+    """One decode step (reference beam_search_op.cc contract, fixed-shape).
+
+    Inputs (flattened beam-major, BW = batch * beam_size):
+      pre_ids    [BW, 1]  last selected token per live beam
+      pre_scores [BW, 1]  cumulative log-prob per beam
+      ids        [BW, K]  top-K candidate tokens from the decoder step
+      scores     [BW, K]  candidate log-probs (already log-softmaxed)
+    Outputs:
+      selected_ids [BW, 1], selected_scores [BW, 1], parent_idx [BW] int32
+      (index into the previous beam layout — gather decoder state with it).
+
+    Finished beams (pre_id == end_id) are frozen: their only continuation is
+    end_id with unchanged cumulative score, the fixed-shape analogue of the
+    reference pruning finished hypotheses out of the LoD.
+    """
+    pre_ids = ctx.input("pre_ids").reshape(-1)
+    pre_scores = ctx.input("pre_scores").reshape(-1)
+    ids, scores = ctx.input("ids"), ctx.input("scores")
+    beam = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    first_step = bool(ctx.attr("is_first_step", False))
+    BW = ids.shape[0]
+    B = BW // beam
+
+    finished = pre_ids == end_id
+    # Append one guaranteed end_id candidate per beam: a finished hypothesis
+    # must survive even when the decoder's top-K for that row doesn't happen
+    # to contain end_id (the reference keeps finished hypotheses outside the
+    # candidate set entirely; fixed shapes force them through the same top-k).
+    ids = jnp.concatenate(
+        [ids, jnp.full((BW, 1), end_id, ids.dtype)], axis=1)
+    scores = jnp.concatenate(
+        [scores, jnp.full((BW, 1), _NEG_INF, scores.dtype)], axis=1)
+    K = ids.shape[1]
+    # candidate cumulative scores; finished beams only propagate themselves
+    cand = pre_scores[:, None] + jnp.where(finished[:, None], 0.0, scores)
+    # frozen beams: kill every ORIGINAL column (the appended end_id column
+    # carries the hypothesis forward at exactly pre_score, no duplicates)
+    col = jnp.arange(K)
+    cand = jnp.where(
+        finished[:, None] & (col[None, :] < K - 1), _NEG_INF, cand)
+    if first_step:
+        # all beams of a batch start identical: keep only beam 0's candidates
+        live0 = (jnp.arange(BW) % beam) == 0
+        cand = jnp.where(live0[:, None], cand, _NEG_INF)
+
+    flat = cand.reshape(B, beam * K)
+    top_scores, top_pos = jax.lax.top_k(flat, beam)        # [B, beam]
+    src_beam = top_pos // K                                 # within-batch beam
+    batch_off = jnp.arange(B, dtype=jnp.int32)[:, None] * beam
+    parent = (batch_off + src_beam).reshape(-1)             # [BW] flat index
+    sel_ids = jnp.take_along_axis(
+        ids.reshape(B, beam * K), top_pos, axis=1).reshape(-1, 1)
+    return {
+        "selected_ids": sel_ids.astype(jnp.int64),
+        "selected_scores": top_scores.reshape(-1, 1),
+        "parent_idx": parent.astype(jnp.int32),
+    }
+
+
+@register_op("beam_search_decode", grad="none")
+def beam_search_decode(ctx: ExecContext):
+    """Backtrack parent pointers (reference beam_search_decode_op.cc).
+
+    Inputs: Ids [T, BW] selected ids per step; ParentIdx [T, BW];
+            Scores [T, BW] cumulative scores per step.
+    Outputs: SentenceIds [BW, T] (each row a full hypothesis, end_id padded),
+             SentenceScores [BW] final cumulative score.
+    """
+    ids, parents = ctx.input("Ids"), ctx.input("ParentIdx")
+    scores = ctx.input("Scores")
+    T = ids.shape[0]
+    end_id = int(ctx.attr("end_id"))
+
+    def step(carry, xs):
+        ptr = carry
+        step_ids, step_parent = xs
+        tok = step_ids[ptr]
+        nxt = step_parent[ptr]
+        return nxt, tok
+
+    init = jnp.arange(ids.shape[1], dtype=jnp.int32)
+    _, toks = jax.lax.scan(
+        step, init, (ids.astype(jnp.int64), parents.astype(jnp.int32)),
+        reverse=True)
+    out = jnp.swapaxes(toks, 0, 1)  # [BW, T]
+    final_scores = scores[-1].reshape(-1)
+    return {"SentenceIds": out.astype(jnp.int64),
+            "SentenceScores": final_scores}

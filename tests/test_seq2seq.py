@@ -1,0 +1,212 @@
+"""Sequence ops, beam search, and the machine-translation book test
+equivalent (reference tests/book/test_machine_translation.py): train an
+attention seq2seq on a copy task, then beam-search decode."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.models import seq2seq
+
+BOS, EOS = 0, 1
+VOCAB = 20
+S = 6  # padded src len (includes no specials)
+T = 8  # padded tgt len
+
+
+def _batch(rng, batch):
+    """Copy task: tgt = src; tgt_in = <s> + tgt[:-1]."""
+    lens = rng.integers(2, S + 1, batch)
+    src = rng.integers(2, VOCAB, (batch, S))
+    for i, ln in enumerate(lens):
+        src[i, ln:] = EOS
+    tgt_out = np.full((batch, T), EOS)
+    tgt_out[:, :S] = src
+    tgt_in = np.roll(tgt_out, 1, axis=1)
+    tgt_in[:, 0] = BOS
+    tgt_lens = lens + 1  # content + EOS
+    return (src.astype(np.int64), lens.astype(np.int64),
+            tgt_in.astype(np.int64), tgt_out.astype(np.int64),
+            tgt_lens.astype(np.int64))
+
+
+def test_beam_search_step_math():
+    """Hand-checked single step (reference beam_search_op_test.cc spirit)."""
+    beam, K = 2, 3
+    pre_ids = np.array([[3], [4]], np.int64)           # B=1, BW=2
+    pre_scores = np.array([[-1.0], [-2.0]], np.float32)
+    ids = np.array([[5, 6, 7], [8, 9, 10]], np.int64)
+    scores = np.log(np.array([[0.5, 0.3, 0.2], [0.6, 0.3, 0.1]], np.float32))
+
+    p_ids = L.data(name="pid", shape=[1], dtype="int64")
+    p_sc = L.data(name="psc", shape=[1], dtype="float32")
+    c_ids = L.data(name="cid", shape=[K], dtype="int64")
+    c_sc = L.data(name="csc", shape=[K], dtype="float32")
+    s_ids, s_sc, par = L.beam_search(p_ids, p_sc, c_ids, c_sc,
+                                     beam_size=beam, end_id=EOS)
+    exe = pt.Executor()
+    outs = exe.run(pt.default_main_program(),
+                   feed={"pid": pre_ids, "psc": pre_scores,
+                         "cid": ids, "csc": scores},
+                   fetch_list=[s_ids, s_sc, par])
+    got_ids, got_sc, got_par = outs
+    # candidates: beam0 -1+log(.5/.3/.2); beam1 -2+log(.6/.3/.1)
+    # best two: beam0 id5 (-1.693), beam0 id6 (-2.204)
+    np.testing.assert_array_equal(got_ids.reshape(-1), [5, 6])
+    np.testing.assert_array_equal(got_par, [0, 0])
+    np.testing.assert_allclose(
+        got_sc.reshape(-1), [-1 + np.log(0.5), -1 + np.log(0.3)], rtol=1e-5)
+
+
+def test_beam_search_frozen_beam_keeps_score():
+    """A finished beam (pre_id == end_id) continues only as end_id with an
+    unchanged cumulative score."""
+    beam, K = 2, 2
+    pre_ids = np.array([[EOS], [3]], np.int64)
+    pre_scores = np.array([[-0.5], [-3.0]], np.float32)
+    ids = np.array([[EOS, 5], [6, 7]], np.int64)
+    scores = np.array([[-0.1, -0.2], [-0.3, -0.4]], np.float32)
+    p_ids = L.data(name="pid", shape=[1], dtype="int64")
+    p_sc = L.data(name="psc", shape=[1], dtype="float32")
+    c_ids = L.data(name="cid", shape=[K], dtype="int64")
+    c_sc = L.data(name="csc", shape=[K], dtype="float32")
+    s_ids, s_sc, par = L.beam_search(p_ids, p_sc, c_ids, c_sc,
+                                     beam_size=beam, end_id=EOS)
+    exe = pt.Executor()
+    got_ids, got_sc, got_par = exe.run(
+        pt.default_main_program(),
+        feed={"pid": pre_ids, "psc": pre_scores, "cid": ids, "csc": scores},
+        fetch_list=[s_ids, s_sc, par])
+    # frozen beam 0 survives at -0.5; live beam 1 continues with id6 at -3.3
+    np.testing.assert_array_equal(got_ids.reshape(-1), [EOS, 6])
+    np.testing.assert_allclose(got_sc.reshape(-1), [-0.5, -3.3], rtol=1e-5)
+    np.testing.assert_array_equal(got_par, [0, 1])
+
+
+def test_beam_search_frozen_beam_survives_without_eos_candidate():
+    """A finished hypothesis must survive even when end_id is NOT in the
+    frozen beam's top-K candidates (it gets an implicit end_id candidate)."""
+    beam, K = 2, 2
+    pre_ids = np.array([[EOS], [5]], np.int64)
+    pre_scores = np.array([[-1.0], [-5.0]], np.float32)
+    ids = np.array([[7, 8], [9, 10]], np.int64)   # no EOS anywhere
+    scores = np.array([[-0.5, -0.7], [-0.5, -0.7]], np.float32)
+    p_ids = L.data(name="pid", shape=[1], dtype="int64")
+    p_sc = L.data(name="psc", shape=[1], dtype="float32")
+    c_ids = L.data(name="cid", shape=[K], dtype="int64")
+    c_sc = L.data(name="csc", shape=[K], dtype="float32")
+    s_ids, s_sc, par = L.beam_search(p_ids, p_sc, c_ids, c_sc,
+                                     beam_size=beam, end_id=EOS)
+    exe = pt.Executor()
+    got_ids, got_sc, got_par = exe.run(
+        pt.default_main_program(),
+        feed={"pid": pre_ids, "psc": pre_scores, "cid": ids, "csc": scores},
+        fetch_list=[s_ids, s_sc, par])
+    # the finished -1.0 hypothesis survives as an implicit end_id candidate
+    np.testing.assert_array_equal(got_ids.reshape(-1), [EOS, 9])
+    np.testing.assert_allclose(got_sc.reshape(-1), [-1.0, -5.5], rtol=1e-5)
+    np.testing.assert_array_equal(got_par, [0, 1])
+
+
+def test_beam_search_decode_backtracks():
+    """Parent-pointer backtrack reconstructs the path (decode_op_test)."""
+    # T=3, BW=2; step ids/parents crafted so beam 0's final path = [7, 9, 11]
+    ids = np.array([[7, 8], [9, 10], [11, 12]], np.int64)
+    parents = np.array([[0, 0], [0, 0], [0, 1]], np.int32)
+    scores = np.array([[0.0, 0.0], [0.0, 0.0], [-1.0, -2.0]], np.float32)
+    i = L.data(name="i", shape=[3, 2], dtype="int64")
+    i.shape = (3, 2)
+    p = L.data(name="p", shape=[3, 2], dtype="int32")
+    p.shape = (3, 2)
+    s = L.data(name="s", shape=[3, 2], dtype="float32")
+    s.shape = (3, 2)
+    sent, sc = L.beam_search_decode(i, p, s, end_id=EOS)
+    exe = pt.Executor()
+    got, gsc = exe.run(pt.default_main_program(),
+                       feed={"i": ids, "p": parents, "s": scores},
+                       fetch_list=[sent, sc])
+    np.testing.assert_array_equal(got[0], [7, 9, 11])
+    # final beam 1 came from step-1 beam 1 (token 10), then step-0 beam 0
+    np.testing.assert_array_equal(got[1], [7, 10, 12])
+    np.testing.assert_allclose(gsc, [-1.0, -2.0])
+
+
+def test_machine_translation_trains_and_decodes():
+    batch = 16
+    src = L.data(name="src", shape=[S], dtype="int64")
+    slen = L.data(name="slen", shape=[], dtype="int64")
+    tin = L.data(name="tin", shape=[T], dtype="int64")
+    tout = L.data(name="tout", shape=[T], dtype="int64")
+    tlen = L.data(name="tlen", shape=[], dtype="int64")
+    loss = seq2seq.train_model(src, slen, tin, tout, tlen, VOCAB,
+                               word_dim=32, hidden_dim=32)
+    pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    first = last = None
+    for i in range(60):
+        s_, sl, ti, to, tl = _batch(rng, batch)
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"src": s_, "slen": sl, "tin": ti,
+                              "tout": to, "tlen": tl},
+                        fetch_list=[loss])
+        lv = float(np.asarray(lv))
+        if first is None:
+            first = lv
+        last = lv
+    assert np.isfinite(last)
+    assert last < first * 0.7, (first, last)
+
+    # decode program shares trained params via the global scope
+    infer_prog = pt.Program()
+    with pt.program_guard(infer_prog, pt.Program()):
+        isrc = L.data(name="src", shape=[S], dtype="int64")
+        isrc.shape = (4, S)  # static batch for the beam layout
+        islen = L.data(name="slen", shape=[], dtype="int64")
+        sent, scores = seq2seq.infer_model(
+            isrc, islen, VOCAB, word_dim=32, hidden_dim=32,
+            beam_size=3, max_len=T, bos_id=BOS, eos_id=EOS)
+    s_, sl, *_ = _batch(rng, 4)
+    got, gsc = exe.run(infer_prog, feed={"src": s_, "slen": sl},
+                       fetch_list=[sent, scores])
+    assert got.shape == (4 * 3, T)
+    assert np.isfinite(np.asarray(gsc)).all()
+    assert ((got >= 0) & (got < VOCAB)).all()
+
+
+def test_dynamic_rnn_masks_by_length():
+    """DynamicRNN freezes state and zeroes outputs beyond each row's length
+    (padding-based equivalent of reference DynamicRNN LoD iteration)."""
+    B, Tn, D, H = 3, 5, 4, 6
+    x = L.data(name="x", shape=[Tn, D], dtype="float32")
+    x.shape = (B, Tn, D)
+    lens = L.data(name="lens", shape=[], dtype="int64")
+    h0 = L.fill_constant([B, H], "float32", 0.0)
+    drnn = L.DynamicRNN()
+    with drnn.block():
+        w = drnn.step_input(x, length=lens)
+        h = drnn.memory(init=h0)
+        h2 = L.fc(L.concat([w, h], axis=1), size=H, act="tanh")
+        drnn.update_memory(h, h2)
+        drnn.output(h2)
+    out = drnn()
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((B, Tn, D)).astype(np.float32)
+    lv = np.array([2, 5, 3])
+    (o,) = exe.run(pt.default_main_program(),
+                   feed={"x": xv, "lens": lv}, fetch_list=[out])
+    assert o.shape == (B, Tn, H)
+    assert np.abs(o[0, 2:]).max() == 0.0          # tail zeroed
+    assert np.abs(o[0, :2]).max() > 0             # valid region computed
+    assert np.abs(o[1]).min() >= 0 and np.abs(o[1, 4]).max() > 0
+    # frozen rows: row 2's state stops evolving after t=3, so a second run
+    # with garbage in the padded tail must give identical valid outputs
+    xv2 = xv.copy()
+    xv2[0, 2:] = 1e6
+    (o2,) = exe.run(pt.default_main_program(),
+                    feed={"x": xv2, "lens": lv}, fetch_list=[out])
+    np.testing.assert_allclose(o[0, :2], o2[0, :2], rtol=1e-6)
+    assert np.abs(o2[0, 2:]).max() == 0.0
