@@ -27,6 +27,7 @@ from . import dataset  # noqa: F401
 from . import transpiler  # noqa: F401
 from . import distributed  # noqa: F401
 from . import inference  # noqa: F401
+from . import dygraph  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

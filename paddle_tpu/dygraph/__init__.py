@@ -1,0 +1,573 @@
+"""Dygraph (imperative) mode: eager op execution with a gradient tape.
+
+TPU-native re-design of the reference imperative layer:
+  * C++ tracer (/root/reference/paddle/fluid/imperative/tracer.cc:35 Trace,
+    layer.cc OpBase/VarBase autograd graph)
+  * python front (/root/reference/python/paddle/fluid/dygraph/base.py guard,
+    layers.py Layer, nn.py FC/Conv2D/Embedding/..., tracer.py)
+
+Design: ops execute eagerly through the SAME registry the static executor
+uses (ops/registry.py) — each call runs the op's JAX compute on concrete
+jax.Arrays (async-dispatched, so python stays ahead of the device) and
+records (op, inputs, outputs) on a tape. `loss.backward()` walks the tape in
+reverse, reusing the registry's derived-vjp grad kernels, so every static op
+is automatically available in dygraph with identical semantics. The
+reference's autograd DAG of OpBase/VarBase nodes collapses to this flat
+tape: eager mode never reenters an op twice, so topological order IS
+recording order.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.types import DType, np_dtype
+from ..ops.registry import ExecContext, get_op_def
+
+__all__ = [
+    "guard",
+    "enabled",
+    "in_dygraph_mode",
+    "to_variable",
+    "no_grad",
+    "VarBase",
+    "Layer",
+    "Linear",
+    "FC",
+    "Conv2D",
+    "Pool2D",
+    "Embedding",
+    "BatchNorm",
+    "LayerNorm",
+]
+
+_state = {"enabled": False, "tape": None, "no_grad": 0, "rng": None}
+
+
+class _Tape:
+    def __init__(self):
+        self.entries = []  # (op_type, attrs, in_slots, out_slots)
+
+    def record(self, op_type, attrs, in_slots, out_slots):
+        self.entries.append((op_type, attrs, in_slots, out_slots))
+
+
+@contextlib.contextmanager
+def guard(seed: int = 0):
+    """reference dygraph/base.py:guard — enable eager mode in the block."""
+    old = dict(_state)
+    _state.update(enabled=True, tape=_Tape(), no_grad=0,
+                  rng=jax.random.PRNGKey(seed))
+    try:
+        yield
+    finally:
+        _state.update(old)
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+in_dygraph_mode = enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    _state["no_grad"] += 1
+    try:
+        yield
+    finally:
+        _state["no_grad"] -= 1
+
+
+def _next_key():
+    _state["rng"], sub = jax.random.split(_state["rng"])
+    return sub
+
+
+class VarBase:
+    """Eager tensor: a jax.Array plus autograd state (reference
+    imperative/layer.h VarBase: var_ + grads_)."""
+
+    _count = 0
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        if isinstance(value, VarBase):
+            value = value._value
+        self._value = jnp.asarray(value)
+        VarBase._count += 1
+        self.name = name or f"dyvar_{VarBase._count}"
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad = None
+
+    # -- reference VarBase API ----------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def gradient(self) -> np.ndarray | None:
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self):
+        backward(self)
+
+    def detach(self) -> "VarBase":
+        return VarBase(self._value, stop_gradient=True)
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return DType.parse(str(self._value.dtype))
+
+    def astype(self, dtype) -> "VarBase":
+        return _dy_op("cast", {"X": [self]},
+                      attrs={"out_dtype": str(dtype)})["Out"]
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape})"
+
+    # arithmetic sugar (reference math_op_patch)
+    def __add__(self, o):
+        return _dy_op("elementwise_add", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def __sub__(self, o):
+        return _dy_op("elementwise_sub", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def __mul__(self, o):
+        return _dy_op("elementwise_mul", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    __radd__ = __add__
+    __rmul__ = __mul__
+
+
+def _lift(v) -> VarBase:
+    return v if isinstance(v, VarBase) else VarBase(v, stop_gradient=True)
+
+
+def to_variable(value, name=None, zero_copy=None) -> VarBase:
+    """reference dygraph/base.py:to_variable."""
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)
+
+
+class _EagerOp:
+    """Shim giving ExecContext the op-shaped view of an eager call."""
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self.inputs = inputs    # slot -> [names]
+        self.outputs = outputs
+        self.attrs = attrs
+
+
+def _dy_op(op_type: str, inputs: dict, attrs: dict | None = None,
+           n_outs: dict | None = None) -> dict:
+    """Execute one registry op eagerly; returns {slot: VarBase|[VarBase]}.
+
+    inputs: {slot: [VarBase]}. The tape records enough to replay the vjp.
+    """
+    if not enabled():
+        raise RuntimeError("dygraph op outside dygraph.guard()")
+    attrs = dict(attrs or {})
+    opdef = get_op_def(op_type)
+    env: dict[str, Any] = {}
+    in_slots = {}
+    name_to_var = {}
+    op_in = {}
+    for slot, vars_ in inputs.items():
+        names = []
+        for v in vars_:
+            if v is None:
+                continue
+            names.append(v.name)
+            env[v.name] = v._value
+            name_to_var[v.name] = v
+        op_in[slot] = names
+        in_slots[slot] = [v for v in vars_ if v is not None]
+
+    rng = _next_key() if opdef.needs_rng else None
+    shim = _EagerOp(op_type, op_in, {}, attrs)
+    ctx = ExecContext(shim, env, rng=rng)
+    outs = opdef.compute(ctx)
+
+    result, out_slots, op_out = {}, {}, {}
+    for slot, val in outs.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        vbs = []
+        for v in vals:
+            if v is None:
+                vbs.append(None)
+                continue
+            vb = VarBase(v)
+            vb.stop_gradient = (
+                _state["no_grad"] > 0
+                or all(x.stop_gradient for vs in in_slots.values()
+                       for x in vs)
+                or opdef.no_grad
+            )
+            vbs.append(vb)
+        op_out[slot] = [vb.name if vb is not None else "" for vb in vbs]
+        out_slots[slot] = vbs
+        result[slot] = vbs if isinstance(val, (list, tuple)) else vbs[0]
+
+    record = not all(
+        vb is None or vb.stop_gradient
+        for vs in out_slots.values() for vb in vs)
+    if record and _state["tape"] is not None:
+        _state["tape"].record(op_type, attrs, in_slots, out_slots)
+    return result
+
+
+def backward(loss: VarBase):
+    """Reverse-walk the tape accumulating grads into VarBase._grad
+    (reference imperative/engine.cc BasicEngine + layer.cc ApplyGrad)."""
+    tape: _Tape = _state["tape"]
+    grads: dict[str, Any] = {
+        loss.name: jnp.ones_like(loss._value)}
+
+    for op_type, attrs, in_slots, out_slots in reversed(tape.entries):
+        out_has_grad = any(
+            vb is not None and vb.name in grads
+            for vs in out_slots.values() for vb in vs)
+        if not out_has_grad:
+            continue
+        opdef = get_op_def(op_type)
+        if opdef.no_grad:
+            continue
+        gdef = get_op_def(op_type + "_grad")
+        derived = getattr(gdef, "derived_vjp", False)
+        # Grad-op view: forward inputs + Out@GRAD cotangents always; forward
+        # OUTPUT slots only for custom grad kernels (they read e.g.
+        # "Softmax"/"Mask" — a derived-vjp kernel must not see output slots
+        # as replay primals)
+        env: dict[str, Any] = {}
+        op_in, op_out = {}, {}
+        for slot, vs in in_slots.items():
+            op_in[slot] = [v.name for v in vs]
+            for v in vs:
+                env[v.name] = v._value
+        for slot, vs in out_slots.items():
+            gnames = []
+            for vb in vs:
+                if vb is None:
+                    gnames.append("")
+                    continue
+                gname = vb.name + "@GRAD"
+                gnames.append(gname)
+                if vb.name in grads:
+                    env[gname] = grads[vb.name]
+                env[vb.name] = vb._value
+            if not derived:
+                op_in[slot] = [vb.name if vb is not None else ""
+                               for vb in vs]
+            op_in[slot + "@GRAD"] = gnames
+        for slot, vs in in_slots.items():
+            op_out[slot + "@GRAD"] = [v.name + "@GRAD" for v in vs]
+
+        gop = _EagerOp(op_type + "_grad", op_in, op_out, attrs)
+        ctx = ExecContext(gop, env, rng=None)
+        gouts = gdef.compute(ctx)
+
+        for slot, val in (gouts or {}).items():
+            if not slot.endswith("@GRAD"):
+                continue
+            fwd_slot = slot[: -len("@GRAD")]
+            vs = in_slots.get(fwd_slot, [])
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            for v, g in zip(vs, vals):
+                if g is None or v.stop_gradient:
+                    continue
+                if v.name in grads:
+                    grads[v.name] = grads[v.name] + g
+                else:
+                    grads[v.name] = g
+                v._grad = grads[v.name]
+    # remember which persistable leaves got grads this sweep (the default
+    # parameter set for optimizer._dygraph_minimize)
+    seen, params = set(), []
+    for _, _, in_slots, _ in tape.entries:
+        for vs in in_slots.values():
+            for v in vs:
+                if v.persistable and v._grad is not None and id(v) not in seen:
+                    seen.add(id(v))
+                    params.append(v)
+    _state["last_params"] = params
+    # the graph is consumed (reference BasicEngine frees op nodes after the
+    # sweep): drop the tape so iteration N+1 doesn't re-walk N iterations of
+    # entries or pin every past activation in device memory
+    tape.entries.clear()
+
+
+# ---------------------------------------------------------------------------
+# Layer system (reference dygraph/layers.py Layer + nn.py built-ins)
+# ---------------------------------------------------------------------------
+
+
+class Layer:
+    """reference dygraph/layers.py:Layer — parameter/sublayer registry with
+    forward() dispatch via __call__."""
+
+    def __init__(self, name_scope: str | None = None, dtype="float32"):
+        self._parameters: dict[str, VarBase] = {}
+        self._sub_layers: dict[str, Layer] = {}
+        self._dtype = dtype
+        self._full_name = name_scope or type(self).__name__.lower()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    def create_parameter(self, shape, dtype="float32", is_bias=False,
+                         default_initializer=None):
+        from ..initializer import Constant, Xavier, _fan_in_out
+
+        init = default_initializer or (Constant(0.0) if is_bias else Xavier())
+
+        class _ShapeOnly:  # _fan_in_out reads .shape (static-var fan rule)
+            pass
+
+        _ShapeOnly.shape = tuple(shape)
+        fan_in, fan_out = _fan_in_out(_ShapeOnly)
+        key = _next_key()
+        val = init._dygraph_sample(key, shape, np_dtype(dtype),
+                                   fan_in, fan_out)
+        p = VarBase(val, persistable=True)
+        return p
+
+    def add_parameter(self, name, param: VarBase) -> VarBase:
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name, layer: "Layer") -> "Layer":
+        self._sub_layers[name] = layer
+        return layer
+
+    def parameters(self, include_sublayers=True) -> list[VarBase]:
+        ps = list(self._parameters.values())
+        if include_sublayers:
+            for sub in self._sub_layers.values():
+                ps.extend(sub.parameters())
+        return ps
+
+    def sublayers(self, include_sublayers=True) -> list["Layer"]:
+        subs = list(self._sub_layers.values())
+        if include_sublayers:
+            for s in self._sub_layers.values():
+                subs.extend(s.sublayers())
+        return subs
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def state_dict(self) -> dict:
+        out = dict(self._parameters)
+        for name, sub in self._sub_layers.items():
+            for k, v in sub.state_dict().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    def set_dict(self, state: dict):
+        for name, p in self._parameters.items():
+            if name in state:
+                v = state[name]
+                p._value = jnp.asarray(
+                    v.numpy() if isinstance(v, VarBase) else v)
+        for name, sub in self._sub_layers.items():
+            prefix = name + "."
+            sub.set_dict({k[len(prefix):]: v for k, v in state.items()
+                          if k.startswith(prefix)})
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and value.persistable:
+            self.__dict__.setdefault("_parameters", {})[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def train(self):
+        self.training = True
+        for s_ in self._sub_layers.values():
+            s_.train()
+
+    def eval(self):
+        self.training = False
+        for s_ in self._sub_layers.values():
+            s_.eval()
+
+
+class Linear(Layer):
+    """reference dygraph FC/Linear (dygraph/nn.py:FC)."""
+
+    def __init__(self, input_dim, output_dim, act=None, dtype="float32",
+                 bias_attr=None):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([input_dim, output_dim], dtype))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.add_parameter(
+                "bias",
+                self.create_parameter([output_dim], dtype, is_bias=True))
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = _dy_op("mul", {"X": [x], "Y": [self.weight]},
+                     attrs={"x_num_col_dims": len(x.shape) - 1})["Out"]
+        if self.bias is not None:
+            out = _dy_op("elementwise_add",
+                         {"X": [out], "Y": [self.bias]},
+                         attrs={"axis": -1})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+FC = Linear
+
+
+class Conv2D(Layer):
+    """reference dygraph/nn.py:Conv2D (NCHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, groups=1, act=None, dtype="float32"):
+        super().__init__()
+        k = filter_size if isinstance(filter_size, (tuple, list)) else (
+            filter_size, filter_size)
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [num_filters, num_channels // groups, k[0], k[1]], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], dtype, is_bias=True))
+        self._attrs = {"strides": [stride, stride],
+                       "paddings": [padding, padding],
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = _dy_op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                     attrs=dict(self._attrs))["Output"]
+        bias = _dy_op("reshape2", {"X": [self.bias]},
+                      attrs={"shape": [1, -1, 1, 1]})["Out"]
+        out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Pool2D(Layer):
+    """reference dygraph/nn.py:Pool2D."""
+
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=2,
+                 pool_padding=0, global_pooling=False):
+        super().__init__()
+        self._attrs = {
+            "ksize": [pool_size, pool_size],
+            "pooling_type": pool_type,
+            "strides": [pool_stride, pool_stride],
+            "paddings": [pool_padding, pool_padding],
+            "global_pooling": global_pooling,
+        }
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _dy_op("pool2d", {"X": [x]}, attrs=dict(self._attrs))["Out"]
+
+
+class Embedding(Layer):
+    """reference dygraph/nn.py:Embedding."""
+
+    def __init__(self, size, is_sparse=False, dtype="float32"):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(list(size), dtype))
+
+    def forward(self, ids: VarBase) -> VarBase:
+        return _dy_op("lookup_table",
+                      {"W": [self.weight], "Ids": [ids]})["Out"]
+
+
+class BatchNorm(Layer):
+    """reference dygraph/nn.py:BatchNorm (training statistics only; running
+    stats update eagerly like the reference's momentum accumulation)."""
+
+    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5,
+                 dtype="float32"):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [num_channels], dtype,
+                default_initializer=_const_init(1.0)))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_channels], dtype,
+                                          is_bias=True))
+        # running stats: NOT persistable (persistable marks trainable
+        # parameters for Layer.__setattr__ auto-registration)
+        self._mean = VarBase(np.zeros(num_channels, np_dtype(dtype)),
+                             stop_gradient=True)
+        self._var = VarBase(np.ones(num_channels, np_dtype(dtype)),
+                            stop_gradient=True)
+        self._attrs = {"momentum": momentum, "epsilon": epsilon}
+
+    def forward(self, x: VarBase) -> VarBase:
+        attrs = dict(self._attrs)
+        # eval(): normalize with running stats, do not update them
+        # (reference batch_norm is_test semantics)
+        attrs["is_test"] = not self.training
+        outs = _dy_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._var]},
+            attrs=attrs)
+        y = outs.get("Y")
+        if self.training:
+            if outs.get("MeanOut") is not None:
+                self._mean._value = outs["MeanOut"]._value  # in place:
+            if outs.get("VarianceOut") is not None:        # keep identity
+                self._var._value = outs["VarianceOut"]._value
+        return y
+
+
+class LayerNorm(Layer):
+    """reference dygraph LayerNorm."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, dtype="float32"):
+        super().__init__()
+        n = (normalized_shape if isinstance(normalized_shape, int)
+             else int(np.prod(normalized_shape)))
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [n], dtype, default_initializer=_const_init(1.0)))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([n], dtype, is_bias=True))
+        self._eps = epsilon
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _dy_op(
+            "layer_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            attrs={"epsilon": self._eps,
+                   "begin_norm_axis": len(x.shape) - 1})["Y"]
+
+
+def _const_init(v):
+    from ..initializer import Constant
+
+    return Constant(v)

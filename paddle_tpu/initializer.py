@@ -27,6 +27,11 @@ class Initializer:
     def __call__(self, var, block):
         raise NotImplementedError
 
+    def _dygraph_sample(self, key, shape, dtype, fan_in=None, fan_out=None):
+        """Eager sampling for dygraph create_parameter (same distribution the
+        static op path produces, drawn from the dygraph guard's PRNG)."""
+        raise NotImplementedError
+
 
 class Constant(Initializer):
     def __init__(self, value=0.0):
@@ -38,6 +43,9 @@ class Constant(Initializer):
             outputs={"Out": [var.name]},
             attrs={"shape": list(var.shape), "dtype": var.dtype.value, "value": self.value},
         )
+
+    def _dygraph_sample(self, key, shape, dtype, fan_in=None, fan_out=None):
+        return np.full(shape, self.value, dtype)
 
 
 class Uniform(Initializer):
@@ -57,6 +65,11 @@ class Uniform(Initializer):
             },
         )
 
+    def _dygraph_sample(self, key, shape, dtype, fan_in=None, fan_out=None):
+        import jax
+
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
 
 class Normal(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
@@ -74,6 +87,11 @@ class Normal(Initializer):
                 "seed": self.seed,
             },
         )
+
+    def _dygraph_sample(self, key, shape, dtype, fan_in=None, fan_out=None):
+        import jax
+
+        return jax.random.normal(key, shape, dtype) * self.scale + self.loc
 
 
 class TruncatedNormal(Initializer):
@@ -118,6 +136,15 @@ class Xavier(Initializer):
         else:
             std = math.sqrt(2.0 / (f_in + f_out))
             Normal(0.0, std, self.seed)(var, block)
+
+    def _dygraph_sample(self, key, shape, dtype, fan_in=None, fan_out=None):
+        f_in = self.fan_in if self.fan_in is not None else fan_in
+        f_out = self.fan_out if self.fan_out is not None else fan_out
+        if self.uniform:
+            limit = math.sqrt(6.0 / (f_in + f_out))
+            return Uniform(-limit, limit)._dygraph_sample(key, shape, dtype)
+        std = math.sqrt(2.0 / (f_in + f_out))
+        return Normal(0.0, std)._dygraph_sample(key, shape, dtype)
 
 
 class MSRA(Initializer):

@@ -54,7 +54,8 @@ def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
     ins = {"X": [x], "PadValue": [pad_value]}
     if length is not None:
         ins["Length"] = [length]
-    helper.append_op("sequence_pad", ins, {"Out": [out], "Length": [ln]}, {})
+    helper.append_op("sequence_pad", ins, {"Out": [out], "Length": [ln]},
+                     {"padded_length": -1 if maxlen is None else int(maxlen)})
     return out, ln
 
 

@@ -154,6 +154,7 @@ def get_op_def(type: str) -> OpDef:
         # derived vjp-based grad kernel, memoized into the registry
         fwd = _REGISTRY[type[: -len("_grad")]]
         d = OpDef(type, _make_vjp_grad_compute(fwd), no_grad=True)
+        d.derived_vjp = True  # replays fwd from its INPUT slots only
         _REGISTRY[type] = d
         return d
     raise KeyError(f"No op registered with type '{type}'")

@@ -59,9 +59,19 @@ def sequence_mask(ctx: ExecContext):
 @register_op("sequence_pad")
 def sequence_pad(ctx: ExecContext):
     """reference sequence_pad_op.cc: keep the valid prefix, set the tail to
-    pad_value. Input is already dense [B, T, ...] + Length."""
+    pad_value. Input is already dense [B, T, ...] + Length; a static
+    padded_length attr (reference's padded_length) truncates or extends the
+    time extent."""
     x, pad = ctx.input("X"), ctx.input("PadValue")
+    maxlen = ctx.attr("padded_length", -1)
+    if maxlen is not None and maxlen > 0 and maxlen != x.shape[1]:
+        if maxlen < x.shape[1]:
+            x = x[:, :maxlen]
+        else:
+            widths = [(0, 0), (0, maxlen - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, widths)
     ln = _lengths(ctx, x.shape[1], x.shape[0])
+    ln = jnp.minimum(ln, x.shape[1])
     mask = _time_mask(ln, x.shape[1], jnp.bool_)
     mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
     out = jnp.where(mask, x, jnp.asarray(pad, x.dtype))

@@ -148,15 +148,82 @@ class Optimizer:
         raise NotImplementedError
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from . import dygraph as _dy
+
+        if _dy.enabled():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
+
+    # -- dygraph (imperative) path ------------------------------------------
+    def _dygraph_minimize(self, loss, parameter_list=None):
+        """Apply updates to eager parameters after loss.backward() (reference
+        dygraph flow: backward() fills VarBase grads, minimize applies).
+
+        parameter_list: VarBase list; defaults to every persistable VarBase
+        that participated in the current tape with a gradient.
+        """
+        import jax.numpy as jnp
+
+        from . import dygraph as _dy
+
+        if parameter_list is None:
+            parameter_list = _dy._state.get("last_params") or []
+        if not hasattr(self, "_dy_state"):
+            self._dy_state = {}
+        lr = self._dygraph_lr()
+        updated = []
+        for p in parameter_list:
+            if p._grad is None:
+                continue
+            g = jnp.asarray(p._grad, p._value.dtype)
+            g = self._dygraph_regularize(p._value, g)
+            state = self._dy_state.setdefault(p.name, {})
+            p._value = self._dygraph_step(p._value, g, lr, state)
+            updated.append(p)
+        return updated, []
+
+    def _dygraph_regularize(self, value, grad):
+        """Weight decay on the eager path (mirror of
+        append_regularization_ops in apply_gradients)."""
+        from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+
+        reg = self.regularization
+        if reg is None:
+            return grad
+        import jax.numpy as jnp
+
+        if isinstance(reg, L2DecayRegularizer):
+            return grad + reg._coeff * value
+        if isinstance(reg, L1DecayRegularizer):
+            return grad + reg._coeff * jnp.sign(value)
+        raise NotImplementedError(
+            f"dygraph regularization for {type(reg).__name__}")
+
+    def _dygraph_lr(self):
+        lr = self._learning_rate
+        if callable(lr):
+            lr = lr()
+        if isinstance(lr, Variable):
+            raise TypeError(
+                "dygraph mode needs a float learning rate (schedulers build "
+                "static-graph variables)")
+        return float(lr)
+
+    def _dygraph_step(self, value, grad, lr, state):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no dygraph update rule "
+            "(SGD/Momentum/Adam support dygraph)")
 
 
 class SGDOptimizer(Optimizer):
     def __init__(self, learning_rate, regularization=None, name=None):
         super().__init__(learning_rate, regularization, name)
         self.type = "sgd"
+
+    def _dygraph_step(self, value, grad, lr, state):
+        return value - lr * grad
 
     def _append_optimize_op(self, block, param_and_grad):
         param, grad = param_and_grad
@@ -177,6 +244,18 @@ class MomentumOptimizer(Optimizer):
         self.type = "momentum"
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+
+    def _dygraph_step(self, value, grad, lr, state):
+        import jax.numpy as jnp
+
+        v = state.get("velocity")
+        if v is None:
+            v = jnp.zeros_like(value)
+        v = self._momentum * v + grad
+        state["velocity"] = v
+        if self._use_nesterov:
+            return value - lr * (grad + self._momentum * v)
+        return value - lr * v
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -278,6 +357,18 @@ class AdamOptimizer(Optimizer):
         super().__init__(learning_rate, regularization, name)
         self.type = "adam"
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _dygraph_step(self, value, grad, lr, state):
+        import jax.numpy as jnp
+
+        m = state.get("m", jnp.zeros_like(value))
+        v = state.get("v", jnp.zeros_like(value))
+        t = state.get("t", 0) + 1
+        m = self._beta1 * m + (1 - self._beta1) * grad
+        v = self._beta2 * v + (1 - self._beta2) * grad * grad
+        state.update(m=m, v=v, t=t)
+        lr_t = lr * (1 - self._beta2 ** t) ** 0.5 / (1 - self._beta1 ** t)
+        return value - lr_t * m / (v ** 0.5 + self._epsilon)
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:

@@ -1,0 +1,157 @@
+"""Dygraph (imperative) mode: eager ops, tape backward, Layer system, and
+the static-vs-imperative equivalence oracle (reference
+unittests/test_imperative_mnist.py pattern: same params + same data =>
+same loss trajectory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph as dg
+from paddle_tpu import layers as L
+from paddle_tpu.dygraph import _dy_op
+
+
+def test_eager_op_and_gradient():
+    with dg.guard(seed=3):
+        x = dg.to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        w = dg.VarBase(np.array([[1.0], [1.0]], np.float32),
+                       persistable=True)
+        y = _dy_op("mul", {"X": [x], "Y": [w]})["Out"]
+        loss = _dy_op("mean", {"X": [y]})["Out"]
+        loss.backward()
+        # dL/dW = X^T @ (0.5 * ones): [[ (1+3)/2 ], [ (2+4)/2 ]]
+        np.testing.assert_allclose(
+            w.gradient(), np.array([[2.0], [3.0]]), rtol=1e-6)
+        np.testing.assert_allclose(float(loss.numpy()), (3 + 7) / 2, rtol=1e-6)
+
+
+def test_stop_gradient_and_no_grad():
+    with dg.guard():
+        x = dg.to_variable(np.ones((2, 2), np.float32))
+        w = dg.VarBase(np.ones((2, 2), np.float32), persistable=True)
+        with dg.no_grad():
+            frozen = _dy_op("elementwise_mul", {"X": [x], "Y": [w]})["Out"]
+        assert frozen.stop_gradient
+        y = _dy_op("elementwise_add", {"X": [frozen], "Y": [w]})["Out"]
+        loss = _dy_op("mean", {"X": [y]})["Out"]
+        loss.backward()
+        # only the add contributes: dL/dw = 1/4
+        np.testing.assert_allclose(w.gradient(), np.full((2, 2), 0.25),
+                                   rtol=1e-6)
+
+
+def test_layer_registry_and_state_dict():
+    with dg.guard(seed=5):
+        class Net(dg.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = dg.Linear(4, 8, act="relu")
+                self.fc2 = dg.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        net = Net()
+        assert len(net.parameters()) == 4
+        sd = net.state_dict()
+        assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+
+        net2 = Net()
+        net2.set_dict(sd)
+        x = dg.to_variable(np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_conv_pool_batchnorm_forward_backward():
+    with dg.guard(seed=7):
+        conv = dg.Conv2D(3, 8, 3, padding=1, act="relu")
+        bn = dg.BatchNorm(8)
+        pool = dg.Pool2D(pool_size=2, pool_type="max", pool_stride=2)
+        x = dg.to_variable(
+            np.random.default_rng(0).standard_normal((2, 3, 8, 8))
+            .astype(np.float32))
+        out = pool(bn(conv(x)))
+        assert out.shape == (2, 8, 4, 4)
+        loss = _dy_op("mean", {"X": [out]})["Out"]
+        loss.backward()
+        assert conv.weight.gradient() is not None
+        assert np.isfinite(conv.weight.gradient()).all()
+
+
+def test_imperative_mnist_matches_static_graph():
+    """Same init + same data: dygraph SGD trajectory == static-graph SGD
+    trajectory (reference test_imperative_mnist.py equivalence)."""
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((5, 16, 10)).astype(np.float32)
+    w_true = rng.standard_normal((10, 1)).astype(np.float32)
+    ys = np.stack([x @ w_true for x in xs])
+
+    # static graph
+    x = L.data(name="x", shape=[10], dtype="float32")
+    yv = L.data(name="y", shape=[1], dtype="float32")
+    h = L.fc(x, size=8, act="tanh", name="h")
+    pred = L.fc(h, size=1, name="p")
+    loss = L.mean(L.square_error_cost(pred, yv))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    init = {n: np.asarray(scope.find_var(n))
+            for n in ("h.w_0", "h.b_0", "p.w_0", "p.b_0")}
+    static_losses = []
+    for i in range(5):
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xs[i], "y": ys[i]}, fetch_list=[loss])
+        static_losses.append(float(np.asarray(lv)))
+
+    # imperative, seeded with the SAME initial params
+    with dg.guard():
+        fc1 = dg.Linear(10, 8, act="tanh")
+        fc2 = dg.Linear(8, 1)
+        fc1.set_dict({"weight": init["h.w_0"], "bias": init["h.b_0"]})
+        fc2.set_dict({"weight": init["p.w_0"], "bias": init["p.b_0"]})
+        sgd = pt.optimizer.SGD(0.1)
+        dy_losses = []
+        for i in range(5):
+            xb = dg.to_variable(xs[i])
+            yb = dg.to_variable(ys[i])
+            pred = fc2(fc1(xb))
+            diff = _dy_op("elementwise_sub", {"X": [pred], "Y": [yb]})["Out"]
+            sq = _dy_op("square", {"X": [diff]})["Out"]
+            lv = _dy_op("mean", {"X": [sq]})["Out"]
+            lv.backward()
+            sgd.minimize(lv, parameter_list=fc1.parameters() + fc2.parameters())
+            for p in fc1.parameters() + fc2.parameters():
+                p.clear_gradient()
+            dy_losses.append(float(lv.numpy()))
+    np.testing.assert_allclose(static_losses, dy_losses, rtol=1e-4)
+
+
+def test_dygraph_adam_and_embedding():
+    with dg.guard(seed=11):
+        emb = dg.Embedding([20, 6])
+        fc = dg.Linear(6, 1)
+        adam = pt.optimizer.Adam(learning_rate=0.05)
+        rng = np.random.default_rng(0)
+        first = last = None
+        for step in range(30):
+            ids = dg.to_variable(rng.integers(0, 20, (8, 1)))
+            target = dg.to_variable(
+                (ids.numpy().astype(np.float32) / 20.0))
+            e = emb(ids)
+            p = fc(e)
+            d = _dy_op("elementwise_sub", {"X": [p], "Y": [target]})["Out"]
+            lv = _dy_op("mean", {"X": [_dy_op("square", {"X": [d]})["Out"]]})["Out"]
+            lv.backward()
+            adam.minimize(lv, parameter_list=emb.parameters() + fc.parameters())
+            for prm in emb.parameters() + fc.parameters():
+                prm.clear_gradient()
+            if first is None:
+                first = float(lv.numpy())
+            last = float(lv.numpy())
+        assert last < first * 0.5, (first, last)
+
+
+def test_dygraph_op_outside_guard_raises():
+    with pytest.raises(RuntimeError):
+        _dy_op("mean", {"X": [dg.VarBase(np.ones(3))]})
