@@ -1,0 +1,2 @@
+"""Model-compression toolkit (reference python/paddle/fluid/contrib/slim/)."""
+from . import quantization  # noqa: F401

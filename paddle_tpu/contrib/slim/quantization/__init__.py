@@ -1,0 +1,1 @@
+from .quantization_pass import QuantizationTransformPass  # noqa: F401

@@ -1,0 +1,99 @@
+"""Quantization-aware-training transform.
+
+TPU-native re-design of the reference's QuantizationTransformPass
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:35): walk the program, and for each quantizable op
+(conv2d/depthwise_conv2d/mul/matmul) insert fused quantize-dequantize ops on
+its weight (abs_max) and activation input (moving-average abs_max). The
+reference rewires an IrGraph; here the Program IR is rewritten directly —
+the inserted ops carry straight-through gradients so minimize() after the
+pass trains quantization-aware, and XLA folds the q/dq arithmetic into the
+surrounding matmul at compile time.
+"""
+from __future__ import annotations
+
+from ....framework import default_main_program
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+
+class QuantizationTransformPass:
+    """reference quantization_pass.py:35 (weight abs_max + activation
+    moving_average_abs_max, the default W8A8 config)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, moving_rate=0.9,
+                 quantizable_op_type=_QUANTIZABLE,
+                 skip_pattern="skip_quant"):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._moving_rate = moving_rate
+        self._types = tuple(quantizable_op_type)
+        self._skip = skip_pattern
+
+    def apply(self, program=None, startup_program=None, for_test=False):
+        """Insert q/dq ops in front of every quantizable op (mutates and
+        returns `program`). Run BEFORE minimize() so the backward pass
+        differentiates through the straight-through estimators."""
+        program = program or default_main_program()
+        block = program.global_block
+        params = {p.name for p in program.all_parameters()}
+        quantized: dict[str, str] = {}  # original name -> q/dq output name
+
+        for op in list(block.ops):
+            if op.type not in self._types or op.attrs.get(self._skip):
+                continue
+            for slot, names in op.inputs.items():
+                for i, n in enumerate(names):
+                    if n in quantized:
+                        names[i] = quantized[n]
+                        continue
+                    try:
+                        var = block.var(n)
+                    except KeyError:
+                        continue
+                    if var.dtype.value not in ("float32", "bfloat16",
+                                               "float16"):
+                        continue
+                    idx = block.ops.index(op)
+                    q = self._insert_qdq(block, idx, var,
+                                         is_weight=n in params,
+                                         for_test=for_test)
+                    quantized[n] = q
+                    names[i] = q
+        program._bump_version()
+        return program
+
+    def _insert_qdq(self, block, idx, var, is_weight, for_test):
+        from .... import unique_name
+
+        out = block.create_var(
+            name=unique_name.generate(var.name + ".quantized"),
+            shape=var.shape, dtype=var.dtype)
+        if is_weight:
+            scale = block.create_var(
+                name=unique_name.generate(var.name + ".scale"),
+                shape=(1,), dtype="float32")
+            block._insert_op(
+                idx, "fake_quantize_dequantize_abs_max",
+                {"X": [var.name]},
+                {"Out": [out.name], "OutScale": [scale.name]},
+                {"bit_length": self._weight_bits})
+        else:
+            # moving-average activation scale: persistable running state,
+            # zero-initialized by the STARTUP program (re-filling it in the
+            # main program would reset the average every step)
+            from ....initializer import Constant
+            from ....layer_helper import LayerHelper
+
+            helper = LayerHelper("quant_scale")
+            state = helper.create_or_get_global_variable(
+                unique_name.generate(var.name + ".ma_scale"), [1],
+                "float32", initializer=Constant(0.0))
+            block._insert_op(
+                idx, "fake_quantize_dequantize_moving_average_abs_max",
+                {"X": [var.name], "InScale": [state.name]},
+                {"Out": [out.name], "OutScale": [state.name]},
+                {"bit_length": self._activation_bits,
+                 "moving_rate": self._moving_rate, "is_test": for_test})
+        return out.name

@@ -65,6 +65,9 @@ def guard(seed: int = 0):
     try:
         yield
     finally:
+        # clear() first: keys created inside the block (e.g. last_params)
+        # must not outlive the guard pinning params/grads in device memory
+        _state.clear()
         _state.update(old)
 
 

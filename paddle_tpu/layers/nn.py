@@ -18,6 +18,9 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "fused_attention",
     "ring_attention",
+    "nce",
+    "hsigmoid",
+    "warpctc",
     "fc",
     "embedding",
     "conv2d",
@@ -1039,3 +1042,78 @@ def ring_attention(q, k, v, causal=False, sm_scale=None, ring_id=0, name=None):
         {"causal": causal, "sm_scale": float(sm_scale), "ring_id": ring_id},
     )
     return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nn.py:5955 / nce_op.h).
+    Returns per-sample cost [B, 1]; negatives drawn per step from the
+    counter-based PRNG (uniform or log_uniform)."""
+    if custom_dist is not None or sample_weight is not None:
+        raise NotImplementedError(
+            "nce: custom_dist / sample_weight are not supported; use "
+            "sampler='uniform' or 'log_uniform'")
+    if sampler not in ("uniform", "log_uniform"):
+        raise ValueError(f"nce: unknown sampler '{sampler}'")
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, [num_total_classes, dim],
+                                input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_total_classes],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    samples = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "nce", inputs, {"Cost": [cost], "SampleLabels": [samples]},
+        {"num_total_classes": int(num_total_classes),
+         "num_neg_samples": int(num_neg_samples or 5),
+         "sampler": {"uniform": 0, "log_uniform": 1}[sampler],
+         "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    nn.py:6169 / hierarchical_sigmoid_op.h SimpleCode). Returns [B, 1]."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid custom trees (path_table/path_code) are not supported; "
+            "the complete-binary-tree SimpleCode layout is")
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, [num_classes - 1, dim],
+                                input.dtype)
+    inputs = {"X": [input], "Label": [label], "W": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_classes - 1],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("hierarchical_sigmoid", inputs,
+                     {"Out": [out], "PreOut": [pre]},
+                     {"num_classes": int(num_classes)})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss (reference nn.py warpctc / warpctc_op.h) on padded batches:
+    input [B, T, V] raw logits, label [B, S]; lengths default to the padded
+    extents."""
+    helper = LayerHelper("warpctc")
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("warpctc", inputs, {"Loss": [loss]},
+                     {"blank": int(blank), "norm_by_times": norm_by_times})
+    return loss

@@ -22,6 +22,8 @@ __all__ = [
     "ones_like",
     "linspace",
     "range",
+    "uniform_random",
+    "gaussian_random",
 ]
 
 
@@ -171,4 +173,28 @@ def range(start, end, step, dtype="int64"):
             "dtype": DType.parse(dtype).value,
         },
     )
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    """reference layers.uniform_random — counter-based PRNG under jit."""
+    helper = LayerHelper("uniform_random", name=name)
+    out = helper.create_variable_for_type_inference(DType.parse(dtype))
+    helper.append_op(
+        "uniform_random", outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": DType.parse(dtype).value,
+               "min": float(min), "max": float(max), "seed": int(seed)})
+    return out
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0,
+                    name=None):
+    """reference layers.gaussian_random."""
+    helper = LayerHelper("gaussian_random", name=name)
+    out = helper.create_variable_for_type_inference(DType.parse(dtype))
+    helper.append_op(
+        "gaussian_random", outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": DType.parse(dtype).value,
+               "mean": float(mean), "std": float(std), "seed": int(seed)})
     return out

@@ -22,3 +22,6 @@ from . import attention_ops  # noqa: F401
 from . import distributed_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import sampling_ops  # noqa: F401
+from . import ctc_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

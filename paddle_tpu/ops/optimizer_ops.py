@@ -309,3 +309,36 @@ def update_loss_scaling(ctx: ExecContext):
         "OutGoodSteps": jnp.reshape(good_next, (1,)).astype(jnp.int32),
         "OutBadSteps": jnp.reshape(bad_next, (1,)).astype(jnp.int32),
     }
+
+
+@register_op("dgc", grad="none", stateful_outputs=("UOut", "VOut"))
+def dgc(ctx: ExecContext):
+    """Deep Gradient Compression step (reference dgc_op.h /
+    DGCMomentumOptimizer, arXiv:1712.01887): momentum correction + local
+    accumulation + top-k sparsification with error feedback.
+
+    u = m*u + g; v = v + u; thr = quantile(|v|, ratio);
+    mask = |v| >= thr; GradOut = v*mask; v *= ~mask; u *= ~mask.
+    GradOut is what rides the allreduce — fixed-shape but mostly zeros,
+    which is the XLA-friendly equivalent of the reference's sparse send.
+    """
+    import jax.numpy as _jnp
+
+    g = ctx.input("Grad")
+    u = ctx.input("U")
+    v = ctx.input("V")
+    m = float(ctx.attr("momentum", 0.9))
+    sparsity = float(ctx.attr("sparsity", 0.999))
+    use_nesterov = bool(ctx.attr("use_nesterov", False))
+    u = m * u + g
+    if use_nesterov:
+        v = v + (g + m * u)
+    else:
+        v = v + u
+    thr = _jnp.quantile(_jnp.abs(v).reshape(-1).astype(_jnp.float32),
+                        sparsity).astype(v.dtype)
+    mask = _jnp.abs(v) >= thr
+    grad_out = _jnp.where(mask, v, 0)
+    v = _jnp.where(mask, 0, v)
+    u = _jnp.where(mask, 0, u)
+    return {"GradOut": grad_out, "UOut": u, "VOut": v}

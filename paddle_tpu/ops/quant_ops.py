@@ -1,0 +1,100 @@
+"""Fake-quantization ops (QAT).
+
+TPU-native re-design of the reference's quantization kernels
+(/root/reference/paddle/fluid/operators/fake_quantize_op.cc:
+FakeQuantizeAbsMax, FakeQuantizeMovingAverageAbsMax, FakeDequantizeMaxAbs).
+
+Quantize-dequantize runs fused in one op (the reference pairs separate
+quant/dequant ops; XLA would fuse them anyway) with a straight-through
+estimator gradient — the round()'s zero derivative is bypassed so QAT
+training works, exactly the behavior the reference's QuantizationTransformPass
+relies on.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import ExecContext, register_grad_compute, register_op
+
+
+def _qdq(x, scale, bits):
+    n = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.clip(jnp.round(x / s * n), -n, n) * s / n
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_quantize_dequantize_abs_max(ctx: ExecContext):
+    """Per-tensor abs-max scale, quantize+dequantize (reference
+    FakeQuantizeAbsMax + FakeDequantizeMaxAbs pair)."""
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _qdq(x, scale, bits).astype(x.dtype),
+            "OutScale": scale.reshape(1)}
+
+
+@register_grad_compute("fake_quantize_dequantize_abs_max")
+def _fqdq_grad(ctx: ExecContext):
+    # straight-through estimator: d out / d x ~= 1 inside the clip range
+    return {"X@GRAD": ctx.input("Out@GRAD")}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def fake_quantize_dequantize_moving_average_abs_max(ctx: ExecContext):
+    """Activation quantization with a moving-average scale (reference
+    FakeQuantizeMovingAverageAbsMax). InScale carries the running scale."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale")
+    bits = int(ctx.attr("bit_length", 8))
+    rate = float(ctx.attr("moving_rate", 0.9))
+    is_test = bool(ctx.attr("is_test", False))
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale.reshape(())
+    else:
+        scale = rate * in_scale.reshape(()) + (1 - rate) * cur
+    return {"Out": _qdq(x, scale, bits).astype(x.dtype),
+            "OutScale": scale.reshape(1)}
+
+
+@register_grad_compute("fake_quantize_dequantize_moving_average_abs_max")
+def _fqdq_ma_grad(ctx: ExecContext):
+    return {"X@GRAD": ctx.input("Out@GRAD")}
+
+
+def _fqdq_ma_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return []
+    return [{
+        "type": "fake_quantize_dequantize_moving_average_abs_max_grad",
+        "inputs": {"Out@GRAD": [grad_var_name(op.output("Out")[0])]},
+        "outputs": {"X@GRAD": [grad_var_name(x)]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+def _fqdq_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    x = op.input("X")[0]
+    if x in no_grad_set:
+        return []
+    return [{
+        "type": "fake_quantize_dequantize_abs_max_grad",
+        "inputs": {"Out@GRAD": [grad_var_name(op.output("Out")[0])]},
+        "outputs": {"X@GRAD": [grad_var_name(x)]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+from .registry import get_op_def  # noqa: E402
+
+get_op_def("fake_quantize_dequantize_abs_max").grad_maker = _fqdq_grad_maker
+get_op_def(
+    "fake_quantize_dequantize_moving_average_abs_max"
+).grad_maker = _fqdq_ma_grad_maker

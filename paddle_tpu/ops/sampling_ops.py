@@ -1,0 +1,166 @@
+"""Large-vocabulary loss ops: NCE and hierarchical sigmoid.
+
+TPU-native re-design of:
+  * /root/reference/paddle/fluid/operators/nce_op.h (sampled softmax-free
+    noise-contrastive estimation; uniform/log-uniform samplers)
+  * /root/reference/paddle/fluid/operators/hierarchical_sigmoid_op.h +
+    math/matrix_bit_code.h SimpleCode (complete-binary-tree path codes:
+    encoding of class c is c + num_classes; weight index = prefix >> (bit+1)
+    - 1, path bit = suffix bit)
+
+Both are fixed-shape and batched for the MXU: negatives are drawn once per
+step with the counter-based PRNG, path tables are computed with static
+max-depth and masked, and the per-node dot products run as one gather +
+batched matmul-ish reduction instead of the reference's per-row loops.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_grad_compute, register_op
+
+
+def _nce_loss(x, label, w, b, samples, C, k, sampler):
+    """Differentiable NCE objective given the drawn negatives `samples`
+    [B, k] (reference nce_op.h:71: logistic vs the noise distribution)."""
+    label = label.reshape(-1)
+
+    if sampler == 1:
+        logq = (jnp.log((samples + 2.0) / (samples + 1.0))
+                - math.log(C + 1))
+        pos_q = (jnp.log((label + 2.0) / (label + 1.0))
+                 - math.log(C + 1))
+    else:
+        logq = jnp.full(samples.shape, -math.log(C))
+        pos_q = jnp.full(label.shape, -math.log(C))
+
+    def logits_of(idx):
+        wi = w[idx]                       # [..., D]
+        out = jnp.einsum("bd,b...d->b...", x, wi)
+        if b is not None:
+            out = out + b.reshape(-1)[idx]
+        return out
+
+    pos_s = logits_of(label[:, None])[:, 0] - (pos_q + math.log(k))
+    neg_s = logits_of(samples) - (logq + math.log(k))
+    return (jax.nn.softplus(-pos_s)
+            + jax.nn.softplus(neg_s).sum(axis=1))[:, None].astype(x.dtype)
+
+
+@register_op("nce", needs_rng=True)
+def nce(ctx: ExecContext):
+    """Noise-contrastive estimation loss (reference nce_op.h:71 forward).
+
+    Inputs: Input [B, D], Label [B, 1] int, Weight [C, D], Bias [C]?
+    Attrs: num_total_classes, num_neg_samples, sampler (0=uniform,
+    1=log_uniform). Outputs: Cost [B, 1] and the drawn SampleLabels [B, k]
+    (the reference also emits its samples; the grad kernel replays from them
+    so forward and backward see the SAME negatives).
+    """
+    x = ctx.input("Input")
+    label = ctx.input("Label")
+    w, b = ctx.input("Weight"), ctx.input("Bias")
+    C = int(ctx.attr("num_total_classes"))
+    k = int(ctx.attr("num_neg_samples", 5))
+    sampler = int(ctx.attr("sampler", 0))
+    B = x.shape[0]
+
+    if sampler == 1:
+        # log-uniform (Zipfian) via inverse CDF: P(c) ∝ log((c+2)/(c+1))
+        u = jax.random.uniform(ctx.rng, (B, k))
+        neg = (jnp.exp(u * math.log(C + 1)) - 1).astype(jnp.int32)
+        neg = jnp.clip(neg, 0, C - 1)
+    else:
+        neg = jax.random.randint(ctx.rng, (B, k), 0, C)
+
+    cost = _nce_loss(x, label, w, b, neg, C, k, sampler)
+    return {"Cost": cost, "SampleLabels": neg.astype(jnp.int64)}
+
+
+@register_grad_compute("nce")
+def nce_grad(ctx: ExecContext):
+    """Replay the objective with the SAVED samples under jax.vjp."""
+    x = ctx.input("Input")
+    label = ctx.input("Label")
+    w, b = ctx.input("Weight"), ctx.input("Bias")
+    samples = ctx.input("SampleLabels").astype(jnp.int32)
+    dcost = ctx.input("Cost@GRAD")
+    C = int(ctx.attr("num_total_classes"))
+    k = int(ctx.attr("num_neg_samples", 5))
+    sampler = int(ctx.attr("sampler", 0))
+
+    if b is None:
+        fn = lambda x_, w_: _nce_loss(x_, label, w_, None, samples, C, k,
+                                      sampler)
+        _, vjp = jax.vjp(fn, x, w)
+        dx, dw = vjp(dcost)
+        return {"Input@GRAD": dx, "Weight@GRAD": dw}
+    fn = lambda x_, w_, b_: _nce_loss(x_, label, w_, b_, samples, C, k,
+                                      sampler)
+    _, vjp = jax.vjp(fn, x, w, b)
+    dx, dw, db = vjp(dcost)
+    return {"Input@GRAD": dx, "Weight@GRAD": dw, "Bias@GRAD": db}
+
+
+def nce_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    ins = {
+        "Input": op.input("Input"),
+        "Label": op.input("Label"),
+        "Weight": op.input("Weight"),
+        "SampleLabels": op.output("SampleLabels"),
+        "Cost@GRAD": [grad_var_name(op.output("Cost")[0])],
+    }
+    outs = {}
+    for slot in ("Input", "Weight", "Bias"):
+        names = op.input(slot)
+        if names and names[0] not in no_grad_set:
+            outs[slot + "@GRAD"] = [grad_var_name(names[0])]
+    if op.input("Bias"):
+        ins["Bias"] = op.input("Bias")
+    if not outs:
+        return []
+    return [{"type": "nce_grad", "inputs": ins, "outputs": outs,
+             "attrs": dict(op.attrs)}]
+
+
+from .registry import get_op_def  # noqa: E402
+
+get_op_def("nce").grad_maker = nce_grad_maker
+
+
+@register_op("hierarchical_sigmoid")
+def hierarchical_sigmoid(ctx: ExecContext):
+    """Complete-binary-tree hsigmoid (reference hierarchical_sigmoid_op.h +
+    SimpleCode). Inputs: X [B, D], Label [B, 1], W [C-1, D], Bias [C-1]?
+    Attr num_classes=C. Output: Out [B, 1] loss; PreOut kept for parity.
+    """
+    x = ctx.input("X")
+    label = ctx.input("Label").reshape(-1).astype(jnp.int32)
+    w = ctx.input("W")
+    bias = ctx.input("Bias")
+    C = int(ctx.attr("num_classes"))
+    # SimpleCode: c_ = label + C; levels below the MSB are the path
+    max_len = max(1, int(math.ceil(math.log2(C))) + 1)
+    c = label + C                                        # [B]
+    bits = jnp.arange(max_len)
+    # get_length = FindLastSet(c)-1 = floor(log2(c))
+    length = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+    valid = bits[None, :] < length[:, None]              # [B, L]
+    idx = jnp.where(valid, (c[:, None] >> (bits[None, :] + 1)) - 1, 0)
+    bit = jnp.where(valid, (c[:, None] >> bits[None, :]) & 1, 0)
+
+    wn = w[idx]                                          # [B, L, D]
+    pre = jnp.einsum("bd,bld->bl", x, wn)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    # sigmoid cross entropy per node with target = bit
+    per_node = jax.nn.softplus(pre) - bit * pre
+    loss = jnp.where(valid, per_node, 0.0).sum(axis=1)
+    return {"Out": loss[:, None].astype(x.dtype),
+            "PreOut": pre.astype(x.dtype)}

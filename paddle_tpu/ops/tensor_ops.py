@@ -337,6 +337,19 @@ def fill_constant_batch_size_like(ctx: ExecContext):
     return {"Out": jnp.full(shape, ctx.attr("value", 0.0), np_dtype(ctx.attr("dtype", "float32")))}
 
 
+@register_op("uniform_random_batch_size_like", grad="none", needs_rng=True)
+def uniform_random_batch_size_like(ctx: ExecContext):
+    """reference uniform_random_batch_size_like_op.cc: shape from attr with
+    the batch dim taken from Input."""
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr("input_dim_idx", 0)]
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    dt = np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": jax.random.uniform(
+        ctx.rng, tuple(int(s) for s in shape), dt, lo, hi)}
+
+
 @register_op("piecewise_decay", grad="none")
 def piecewise_decay(ctx: ExecContext):
     """LR piecewise constant schedule, fused (reference

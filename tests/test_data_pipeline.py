@@ -160,3 +160,36 @@ def test_imdb_honors_custom_word_idx():
     wd = {f"w{i}": i for i in range(100)}
     ids, label = next(imdb.train(word_idx=wd)())
     assert max(ids) < 100
+
+
+def test_new_datasets_yield_contract_tuples():
+    """movielens/wmt14/conll05/sentiment/flowers/imikolov sample shapes."""
+    from paddle_tpu.dataset import (conll05, flowers, imikolov, movielens,
+                                    sentiment, wmt14)
+
+    s = next(iter(movielens.train()()))
+    assert len(s) == 8 and isinstance(s[5], list) and s[7][0] >= 1.0
+    assert movielens.max_user_id() > 0 and len(movielens.age_table) == 7
+
+    src, tin, tnext = next(iter(wmt14.train(1000)()))
+    assert tin[0] == wmt14.BOS and tnext[-1] == wmt14.EOS
+    assert tin[1:] == tnext[:-1]
+
+    sample = next(iter(conll05.test()()))
+    assert len(sample) == 9
+    n = len(sample[0])
+    assert all(len(f) == n for f in sample[1:])
+    assert sum(sample[7]) == 1  # exactly one predicate mark
+
+    ids, label = next(iter(sentiment.train()()))
+    assert label in (0, 1) and len(ids) > 0
+    assert len(sentiment.get_word_dict()) > 0
+
+    img, lbl = next(iter(flowers.train()()))
+    assert img.shape == (3 * 224 * 224,) and 0 <= lbl < 102
+
+    wd = imikolov.build_dict()
+    grams = list(imikolov.train(wd, 5)())[:3]
+    assert all(len(g) == 5 for g in grams)
+    src, trg = next(iter(imikolov.train(wd, 5, imikolov.DataType.SEQ)()))
+    assert trg[:-1] == src[1:]

@@ -1,0 +1,198 @@
+"""NCE / hierarchical sigmoid / CTC losses, distributions, QAT pass, and the
+DGC optimizer (reference: nce_op.h, hierarchical_sigmoid_op.h, warpctc_op.h,
+layers/distributions.py, slim QuantizationTransformPass, optimizer.py DGC)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def test_warpctc_matches_bruteforce():
+    """Alpha recursion equals explicit path enumeration on a tiny case."""
+    T, V = 4, 3
+    blank = 0
+    label = [1, 2]
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((1, T, V)).astype(np.float32)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+
+    def collapses_to(path, target):
+        out, prev = [], None
+        for p in path:
+            if p != blank and p != prev:
+                out.append(p)
+            prev = p
+        return out == target
+
+    total = -np.inf
+    for path in itertools.product(range(V), repeat=T):
+        if collapses_to(list(path), label):
+            lp = sum(logp[0, t, c] for t, c in enumerate(path))
+            total = np.logaddexp(total, lp)
+    expect = -total
+
+    lg = L.data(name="lg", shape=[T, V], dtype="float32")
+    lab = L.data(name="lab", shape=[len(label)], dtype="int64")
+    loss = L.warpctc(lg, lab, blank=blank)
+    exe = pt.Executor()
+    (got,) = exe.run(pt.default_main_program(),
+                     feed={"lg": logits,
+                           "lab": np.array([label], np.int64)},
+                     fetch_list=[loss])
+    np.testing.assert_allclose(float(got.reshape(-1)[0]), expect, rtol=1e-4)
+
+
+def test_hsigmoid_path_consistency():
+    """hsigmoid loss equals a numpy replay of the SimpleCode path."""
+    rng = np.random.default_rng(1)
+    D, C, B = 6, 10, 4
+    xv = rng.standard_normal((B, D)).astype(np.float32)
+    lbl = rng.integers(0, C, (B, 1)).astype(np.int64)
+
+    x = L.data(name="x", shape=[D], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="int64")
+    out = L.hsigmoid(x, y, num_classes=C,
+                     param_attr=pt.ParamAttr(name="hs.w"),
+                     bias_attr=pt.ParamAttr(name="hs.b"))
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    (got,) = exe.run(pt.default_main_program(),
+                     feed={"x": xv, "y": lbl}, fetch_list=[out])
+    w = np.asarray(pt.global_scope().find_var("hs.w"))
+    b = np.asarray(pt.global_scope().find_var("hs.b"))
+
+    def ref_loss(x_row, c):
+        code = c + C
+        length = int(np.floor(np.log2(code)))
+        loss = 0.0
+        for d in range(length):
+            idx = (code >> (d + 1)) - 1
+            bit = (code >> d) & 1
+            pre = x_row @ w[idx] + b[idx]
+            loss += np.log1p(np.exp(pre)) - bit * pre
+        return loss
+
+    expect = np.array([ref_loss(xv[i], int(lbl[i, 0])) for i in range(B)])
+    np.testing.assert_allclose(got.reshape(-1), expect, rtol=1e-4)
+
+
+def test_nce_trains_and_uses_saved_samples():
+    rng = np.random.default_rng(2)
+    x = L.data(name="x", shape=[16], dtype="float32")
+    lbl = L.data(name="lbl", shape=[1], dtype="int64")
+    cost = L.mean(L.nce(x, lbl, num_total_classes=40, num_neg_samples=8,
+                        sampler="log_uniform"))
+    pt.optimizer.SGD(0.1).minimize(cost)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    first = last = None
+    for i in range(40):
+        xb = rng.standard_normal((16, 16)).astype(np.float32)
+        yb = (np.abs(xb[:, :1]).round().astype(np.int64) % 40)
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xb, "lbl": yb}, fetch_list=[cost])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert np.isfinite(last) and last < first, (first, last)
+
+
+def test_distributions_math():
+    from paddle_tpu.layers.distributions import Categorical, Normal, Uniform
+
+    n1 = Normal(0.0, 1.0)
+    n2 = Normal(1.0, 2.0)
+    u = Uniform(0.0, 2.0)
+    x = L.data(name="x", shape=[3], dtype="float32")
+    cat = Categorical(x)
+    fetches = [n1.entropy(), n1.kl_divergence(n2), u.entropy(),
+               n1.log_prob(L.fill_constant([1], "float32", 0.0)),
+               cat.entropy(), cat.sample(seed=5)]
+    exe = pt.Executor()
+    outs = exe.run(pt.default_main_program(),
+                   feed={"x": np.log(np.array([[0.5, 0.25, 0.25]],
+                                              np.float32))},
+                   fetch_list=fetches)
+    np.testing.assert_allclose(float(np.asarray(outs[0]).reshape(-1)[0]),
+                               0.5 + 0.5 * np.log(2 * np.pi), rtol=1e-5)
+    # KL(N(0,1)||N(1,2)) = log(2) + (1+1)/8 - 1/2
+    np.testing.assert_allclose(float(np.asarray(outs[1]).reshape(-1)[0]),
+                               np.log(2.0) + 2.0 / 8 - 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(outs[2]).reshape(-1)[0]),
+                               np.log(2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(outs[3]).reshape(-1)[0]),
+                               -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    expect_ent = -(0.5 * np.log(0.5) + 2 * 0.25 * np.log(0.25))
+    np.testing.assert_allclose(float(np.asarray(outs[4]).reshape(-1)[0]),
+                               expect_ent, rtol=1e-4)
+    assert 0 <= int(np.asarray(outs[5]).reshape(-1)[0]) < 3
+
+
+def test_quantization_pass_qat():
+    from paddle_tpu.contrib.slim.quantization import QuantizationTransformPass
+
+    x = L.data(name="x", shape=[8], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    pred = L.fc(L.fc(x, size=16, act="relu"), size=1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    QuantizationTransformPass().apply()
+    types = [op.type for op in pt.default_main_program().global_block.ops]
+    assert sum("fake_quantize" in t for t in types) >= 4
+    pt.optimizer.SGD(0.05).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    first = last = None
+    for i in range(60):
+        xb = rng.standard_normal((32, 8)).astype(np.float32)
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.2, (first, last)
+
+
+def test_fake_quant_levels():
+    """Quantized values land on <= 2^bits distinct levels."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    x = L.data(name="x", shape=[64], dtype="float32")
+    helper = LayerHelper("fq")
+    out = helper.create_variable_for_type_inference("float32")
+    scale = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fake_quantize_dequantize_abs_max", {"X": [x]},
+                     {"Out": [out], "OutScale": [scale]}, {"bit_length": 4})
+    exe = pt.Executor()
+    xv = np.random.default_rng(4).standard_normal((2, 64)).astype(np.float32)
+    (got, sc) = exe.run(pt.default_main_program(), feed={"x": xv},
+                        fetch_list=[out, scale])
+    assert len(np.unique(got.round(6))) <= 2 ** 4
+    np.testing.assert_allclose(float(sc[0]), np.abs(xv).max(), rtol=1e-6)
+
+
+def test_dgc_momentum_converges_and_sparsifies():
+    x = L.data(name="x", shape=[12], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+    pt.optimizer.DGCMomentumOptimizer(
+        0.05, momentum=0.9, sparsity=[0.9]).minimize(loss)
+    types = [op.type for op in pt.default_main_program().global_block.ops]
+    assert "dgc" in types
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((12, 1)).astype(np.float32)
+    first = last = None
+    for i in range(80):
+        xb = rng.standard_normal((32, 12)).astype(np.float32)
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.1, (first, last)
