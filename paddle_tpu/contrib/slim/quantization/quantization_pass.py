@@ -58,13 +58,15 @@ class QuantizationTransformPass:
                     idx = block.ops.index(op)
                     q = self._insert_qdq(block, idx, var,
                                          is_weight=n in params,
-                                         for_test=for_test)
+                                         for_test=for_test,
+                                         startup_program=startup_program)
                     quantized[n] = q
                     names[i] = q
         program._bump_version()
         return program
 
-    def _insert_qdq(self, block, idx, var, is_weight, for_test):
+    def _insert_qdq(self, block, idx, var, is_weight, for_test,
+                    startup_program=None):
         from .... import unique_name
 
         out = block.create_var(
@@ -82,14 +84,21 @@ class QuantizationTransformPass:
         else:
             # moving-average activation scale: persistable running state,
             # zero-initialized by the STARTUP program (re-filling it in the
-            # main program would reset the average every step)
-            from ....initializer import Constant
-            from ....layer_helper import LayerHelper
+            # main program would reset the average every step). Bound to the
+            # PASSED programs — LayerHelper would silently target the
+            # defaults when apply() is given explicit programs.
+            from ....framework import default_startup_program
 
-            helper = LayerHelper("quant_scale")
-            state = helper.create_or_get_global_variable(
-                unique_name.generate(var.name + ".ma_scale"), [1],
-                "float32", initializer=Constant(0.0))
+            state = block.create_var(
+                name=unique_name.generate(var.name + ".ma_scale"),
+                shape=(1,), dtype="float32", persistable=True)
+            sp = startup_program or default_startup_program()
+            sblk = sp.global_block
+            sblk.create_var(name=state.name, shape=(1,), dtype="float32",
+                            persistable=True)
+            sblk.append_op(
+                "fill_constant", {}, {"Out": [state.name]},
+                {"shape": [1], "dtype": "float32", "value": 0.0})
             block._insert_op(
                 idx, "fake_quantize_dequantize_moving_average_abs_max",
                 {"X": [var.name], "InScale": [state.name]},

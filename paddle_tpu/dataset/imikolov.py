@@ -44,7 +44,9 @@ def build_dict(min_word_freq: int = 50) -> dict:
         words = [w for w, c in freq.items() if c >= min_word_freq]
         d = {w: i for i, w in enumerate(sorted(words))}
     else:
-        d = {f"w{i}": i for i in range(_VOCAB - 2)}
+        d = {f"w{i}": i for i in range(_VOCAB - 3)}
+    # reference build_dict counts the specials into the vocabulary
+    d["<s>"] = len(d)
     d["<unk>"] = len(d)
     d["<e>"] = len(d)
     return d
@@ -73,15 +75,17 @@ def _sentences(split, n, seed, word_idx):
 
 def _reader(split, n, seed, word_idx, ngram_n, data_type):
     def reader():
-        e = word_idx["<e>"]
+        s_, e = word_idx["<s>"], word_idx["<e>"]
         for sent in _sentences(split, n, seed, word_idx):
             if data_type == DataType.NGRAM:
-                l = sent + [e]
+                # reference wraps sentences ['<s>'] + l + ['<e>']
+                l = [s_] + sent + [e]
                 if len(l) >= ngram_n:
                     for i in range(ngram_n, len(l) + 1):
                         yield tuple(l[i - ngram_n:i])
             else:
-                yield sent, sent[1:] + [e]
+                # reference SEQ: src = [<s>] + l, trg = l + [<e>]
+                yield [s_] + sent, sent + [e]
 
     return reader
 

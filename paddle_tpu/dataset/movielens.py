@@ -76,7 +76,8 @@ def _real():
 
         for line in _lines("users.dat"):
             uid, gender, age, job, _ = line.split("::")
-            users[int(uid)] = [int(uid), int(gender == "M"),
+            # reference UserInfo.value(): 0 if male else 1
+            users[int(uid)] = [int(uid), int(gender != "M"),
                               age_table.index(int(age)), int(job)]
         for line in _lines("movies.dat"):
             mid, title, cats = line.split("::")

@@ -10,7 +10,7 @@ from __future__ import annotations
 from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["While", "cond", "Switch", "StaticRNN", "DynamicRNN",
+__all__ = ["While", "cond", "Switch", "IfElse", "StaticRNN", "DynamicRNN",
            "less_than", "less_equal",
            "greater_than", "greater_equal", "equal", "not_equal",
            "logical_and", "logical_or", "logical_not", "logical_xor"]
@@ -219,19 +219,72 @@ def cond(pred: Variable, true_fn, false_fn=None, name=None):
 
 
 class Switch:
-    """Reference Switch (control_flow.py:1622): a case ladder used mainly by
-    LR warmup schedules. Implemented as nested functional conds at build
-    time: each case's ops run in a sub-block."""
+    """Reference Switch (control_flow.py:1622): a first-true case ladder,
+    used mainly by LR warmup schedules.
+
+        with Switch() as switch:
+            with switch.case(cond1):
+                tensor.assign(a, lr)
+            with switch.default():
+                tensor.assign(b, lr)
+
+    Each case body is traced into a sub-block; the switch_case op computes
+    every body and merges each written outer var with a nested first-true
+    select — the functional XLA equivalent of "execute the first matching
+    case". Case bodies must be side-effect-free beyond outer-var writes
+    (true for every reference LR schedule)."""
 
     def __init__(self, name=None):
         self.helper = LayerHelper("switch", name=name)
-        self._cases = []  # (pred_var or None, fn)
+        self._cases = []  # (cond var or None, sub_block)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            return False
+        self._build()
+        return False
 
     def case(self, condition):
+        if self._cases and self._cases[-1][0] is None:
+            raise ValueError("Switch: case() after default()")
         return _SwitchCase(self, condition)
 
     def default(self):
         return _SwitchCase(self, None)
+
+    def _build(self):
+        if not self._cases:
+            raise ValueError("Switch: no cases")
+        parent = default_main_program().current_block()
+        conds = [c for c, _ in self._cases if c is not None]
+        has_default = self._cases[-1][0] is None
+        blocks = [b for _, b in self._cases]
+        # union of outer vars written by any case: those are the outputs
+        writes: list[str] = []
+        deps: list[str] = []
+        for _, blk in self._cases:
+            r, w = _block_io(blk, parent)
+            for n in w:
+                if n not in writes:
+                    writes.append(n)
+            for n in r:
+                if n not in deps:
+                    deps.append(n)
+        if not writes:
+            raise ValueError(
+                "Switch: no case assigns to an outer-scope variable")
+        deps = [n for n in deps if n not in writes]
+        parent.append_op(
+            "switch_case",
+            {"Conds": [c.name for c in conds], "Deps": deps},
+            {"Out": writes},
+            {"sub_blocks": [b.idx for b in blocks],
+             "has_default": has_default,
+             "dep_names": deps},
+        )
 
 
 class _SwitchCase:
@@ -240,13 +293,87 @@ class _SwitchCase:
         self.condition = condition
 
     def __enter__(self):
-        raise NotImplementedError(
-            "Switch with-block syntax needs deferred assign support; use "
-            "layers.cond(pred, true_fn, false_fn) or piecewise_decay/"
-            "linear_lr_warmup which are already branchless")
+        self._guard = BlockGuard()
+        self._block = self._guard.__enter__()
+        return self
 
-    def __exit__(self, *a):
+    def __exit__(self, exc_type, *a):
+        self._guard.__exit__(exc_type, *a)
+        if exc_type is None:
+            self.switch._cases.append((self.condition, self._block))
         return False
+
+
+class IfElse:
+    """Reference IfElse (control_flow.py:1897): per-row conditional.
+
+    The reference physically splits the batch by the [B, 1] bool condition,
+    runs each block on its row subset, and merges. Ragged splits defeat XLA,
+    so both blocks compute on the FULL batch and the merge selects per row —
+    identical results whenever the blocks are row-wise (the documented
+    contract; a cross-row reduction inside a block would see all rows).
+
+        ie = IfElse(cond)                 # cond: [B, 1] bool
+        with ie.true_block():
+            ie.output(f(ie.input(x)))
+        with ie.false_block():
+            ie.output(g(ie.input(x)))
+        out = ie()                         # [B, ...] row-merged
+    """
+
+    def __init__(self, cond: Variable, name=None):
+        self._cond = cond
+        self._outs = {True: [], False: []}
+        self._in_branch: bool | None = None
+
+    def _branch(self, flag: bool):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._in_branch = flag
+            try:
+                yield
+            finally:
+                self._in_branch = None
+
+        return guard()
+
+    def true_block(self):
+        return self._branch(True)
+
+    def false_block(self):
+        return self._branch(False)
+
+    def input(self, x: Variable) -> Variable:
+        """The reference returns the rows where cond matches; here the full
+        batch flows through (selection happens at the merge)."""
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.input outside a block")
+        return x
+
+    def output(self, *outs):
+        if self._in_branch is None:
+            raise RuntimeError("IfElse.output outside a block")
+        self._outs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        from . import nn as _nn
+
+        t, f = self._outs[True], self._outs[False]
+        if len(t) != len(f):
+            raise ValueError(
+                f"IfElse: true block produced {len(t)} outputs, false block "
+                f"{len(f)} — they must match")
+        res = []
+        for tv, fv in zip(t, f):
+            cond = self._cond
+            # align cond rank to the output ([B,1] vs [B,...]); where()
+            # selects, so a NaN/inf in the dead branch cannot leak through
+            while len(cond.shape) < len(tv.shape):
+                cond = _nn.unsqueeze(cond, axes=[-1])
+            res.append(_nn.where(cond, tv, fv))
+        return res[0] if len(res) == 1 else res
 
 
 class StaticRNN:

@@ -135,7 +135,13 @@ class Categorical(Distribution):
             L.reduce_sum(L.elementwise_mul(p, onehot), dim=-1), bias=1e-12))
 
     def sample(self, shape=None, seed=0):
-        """Gumbel-max sampling: argmax(logits + G) — jit-friendly."""
+        """Gumbel-max sampling: argmax(logits + G), one draw per logits row
+        — jit-friendly. (The reference Categorical has no sample(); a
+        multi-draw `shape` is not supported.)"""
+        if shape:
+            raise NotImplementedError(
+                "Categorical.sample draws one sample per logits row; "
+                "tile the logits for multiple draws")
         from ..layer_helper import LayerHelper
 
         helper = LayerHelper("categorical_sample")

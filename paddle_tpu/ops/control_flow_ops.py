@@ -155,3 +155,47 @@ def static_rnn(ctx: ExecContext):
 
     final_mems, stacked = jax.lax.scan(body, mems, xs + (step_keys,))
     return {"Outputs": list(stacked), "FinalMemories": list(final_mems)}
+
+
+@register_op("switch_case", needs_rng=True)
+def switch_case(ctx: ExecContext):
+    """Case ladder (reference switch_op.cc / control_flow.py Switch:1622).
+
+    inputs: Conds=[c1..cn], Deps; attrs: sub_blocks=[idx...] (one per case,
+    last one is the default when has_default), dep_names, out_names (outer
+    vars the cases write); outputs: Out (merged values).
+
+    XLA-native lowering: every case body is traced and computed; the merged
+    value is a nested select with FIRST-TRUE priority (exactly the
+    reference's first-matching-case execution, minus side effects — case
+    bodies must be functional, which LR schedules are).
+    """
+    conds = [jnp.reshape(c, ()).astype(jnp.bool_)
+             for c in ctx.inputs("Conds")]
+    blocks = list(ctx.attr("sub_blocks"))
+    has_default = bool(ctx.attr("has_default", False))
+    out_names = ctx.op.outputs.get("Out", [])
+    base_env = _outer_env(ctx)
+    key = _op_rng(ctx)
+
+    branch_vals = []
+    for i, idx in enumerate(blocks):
+        env = dict(base_env)
+        env["__rng_key"] = jax.random.fold_in(key, i)
+        env = ctx.lowerer(idx)(env)
+        branch_vals.append([jnp.asarray(env[n]) for n in out_names])
+
+    if has_default:
+        merged = list(branch_vals[-1])
+        cased = branch_vals[:-1]
+    else:
+        missing = [n for n in out_names if n not in base_env]
+        if missing:
+            raise ValueError(
+                f"switch_case outputs {missing} have no prior value and no "
+                f"default() case")
+        merged = [jnp.asarray(base_env[n]) for n in out_names]
+        cased = branch_vals
+    for cond, vals in reversed(list(zip(conds, cased))):
+        merged = [jnp.where(cond, v, m) for v, m in zip(vals, merged)]
+    return {"Out": merged}

@@ -30,10 +30,11 @@ def _nce_loss(x, label, w, b, samples, C, k, sampler):
     label = label.reshape(-1)
 
     if sampler == 1:
-        logq = (jnp.log((samples + 2.0) / (samples + 1.0))
-                - math.log(C + 1))
-        pos_q = (jnp.log((label + 2.0) / (label + 1.0))
-                 - math.log(C + 1))
+        # P(c) = log((c+2)/(c+1)) / log(C+1)  =>  log P needs the OUTER log
+        logq = (jnp.log(jnp.log((samples + 2.0) / (samples + 1.0)))
+                - math.log(math.log(C + 1)))
+        pos_q = (jnp.log(jnp.log((label + 2.0) / (label + 1.0)))
+                 - math.log(math.log(C + 1)))
     else:
         logq = jnp.full(samples.shape, -math.log(C))
         pos_q = jnp.full(label.shape, -math.log(C))

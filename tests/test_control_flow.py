@@ -197,3 +197,50 @@ def test_static_rnn_dropout_varies_per_step():
     masks = (got != 0).reshape(T_, -1)
     # adjacent steps must not share the identical mask
     assert not all((masks[t] == masks[0]).all() for t in range(1, T_))
+
+
+def test_switch_first_true_case_wins():
+    """Switch executes the first matching case (reference Switch:1622)."""
+    import numpy as np
+
+    from paddle_tpu.layers import tensor as T
+
+    step = L.data(name="step", shape=[], dtype="float32")
+    lr = T.create_global_var([1], 0.0, "float32", name="sw_lr")
+    c1 = L.less_than(step, T.fill_constant([], "float32", 10.0))
+    c2 = L.less_than(step, T.fill_constant([], "float32", 100.0))
+    with L.Switch() as sw:
+        with sw.case(c1):
+            T.assign(T.fill_constant([1], "float32", 0.001), lr)
+        with sw.case(c2):
+            T.assign(T.fill_constant([1], "float32", 0.01), lr)
+        with sw.default():
+            T.assign(T.fill_constant([1], "float32", 0.1), lr)
+    exe = pt.Executor()
+    vals = [float(exe.run(pt.default_main_program(),
+                          feed={"step": np.float32(s)},
+                          fetch_list=[lr])[0][0])
+            for s in (5.0, 50.0, 500.0)]
+    np.testing.assert_allclose(vals, [0.001, 0.01, 0.1], rtol=1e-6)
+
+
+def test_ifelse_rowwise_merge():
+    """IfElse merges per-row branch results (reference IfElse:1897; the
+    batch split becomes a row-wise select on the padded layout)."""
+    import numpy as np
+
+    x = L.data(name="x", shape=[3], dtype="float32")
+    c = L.data(name="c", shape=[1], dtype="bool")
+    ie = L.IfElse(c)
+    with ie.true_block():
+        ie.output(L.scale(ie.input(x), scale=10.0))
+    with ie.false_block():
+        ie.output(L.scale(ie.input(x), scale=0.0, bias=-1.0))
+    out = ie()
+    exe = pt.Executor()
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    cv = np.array([[True], [False]])
+    (got,) = exe.run(pt.default_main_program(),
+                     feed={"x": xv, "c": cv}, fetch_list=[out])
+    np.testing.assert_allclose(got[0], xv[0] * 10.0)
+    np.testing.assert_allclose(got[1], [-1.0, -1.0, -1.0])
