@@ -1,0 +1,93 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py:
+prior_box:1500, box_coder:704, iou_similarity:660, multiclass_nms:2127,
+detection_output:160) on the padding contract — NMS output is a fixed
+[N, keep_top_k, 6] tensor with label -1 padding instead of a LoD tensor.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms",
+           "detection_output"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None, offset=0.5,
+              name=None):
+    """reference detection.py:1500 -> (boxes [H,W,P,4], variances)."""
+    helper = LayerHelper("prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    var = helper.create_variable_for_type_inference("float32")
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        "prior_box", {"Input": [input], "Image": [image]},
+        {"Boxes": [boxes], "Variances": [var]},
+        {"min_sizes": list(min_sizes),
+         "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios or [1.0]),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "flip": flip, "clip": clip,
+         "step_w": float(steps[0]), "step_h": float(steps[1]),
+         "offset": offset})
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    """reference detection.py:704."""
+    if axis != 0:
+        raise NotImplementedError(
+            "box_coder: only axis=0 (priors broadcast along dim 0) is "
+            "supported")
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    helper.append_op("box_coder", ins, {"OutputBox": [out]},
+                     {"code_type": code_type,
+                      "box_normalized": box_normalized})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    """reference detection.py:660 — pairwise IoU [N, M]."""
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("iou_similarity", {"X": [x], "Y": [y]},
+                     {"Out": [out]}, {})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """reference detection.py:2127 — output [N, keep_top_k, 6] rows of
+    (label, score, x1, y1, x2, y2); label -1 marks padding."""
+    if nms_eta != 1.0:
+        raise NotImplementedError(
+            "multiclass_nms: adaptive NMS (nms_eta != 1.0) is not supported")
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "multiclass_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+        {"Out": [out]},
+        {"score_threshold": float(score_threshold),
+         "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+         "nms_threshold": float(nms_threshold),
+         "normalized": bool(normalized),
+         "background_label": int(background_label)})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference detection.py:160 — decode SSD locations against priors then
+    multiclass NMS. loc [N, M, 4] offsets, scores [N, C, M] (softmaxed)."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    return multiclass_nms(decoded, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold,
+                          background_label=background_label)

@@ -25,3 +25,4 @@ from . import rnn_ops  # noqa: F401
 from . import sampling_ops  # noqa: F401
 from . import ctc_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import detection_ops  # noqa: F401

@@ -1,0 +1,207 @@
+"""Detection ops: SSD-style priors, box coding, IoU, NMS.
+
+TPU-native re-design of the reference detection operator family
+(/root/reference/paddle/fluid/operators/detection/): prior_box_op.h,
+box_coder_op.h, iou_similarity_op.h, multiclass_nms_op.cc.
+
+Everything is fixed-shape: NMS returns a [keep_top_k, 6] tensor padded with
+-1 labels (the reference returns a LoD tensor of variable length; the padded
+layout carries the same detections with an explicit validity convention),
+and suppression runs as a lax.scan over the score-sorted candidates instead
+of the reference's data-dependent while loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_op
+
+
+@register_op("prior_box", grad="none")
+def prior_box(ctx: ExecContext):
+    """SSD prior boxes (reference prior_box_op.h): one box per
+    (min_size, aspect_ratio) plus the sqrt(min*max) box, centered on each
+    feature-map cell, normalized to the image."""
+    feat = ctx.input("Input")    # [N, C, H, W]
+    img = ctx.input("Image")     # [N, 3, IH, IW]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ars = [float(a) for a in ctx.attr("aspect_ratios", [1.0]) or [1.0]]
+    flip = bool(ctx.attr("flip", False))
+    clip = bool(ctx.attr("clip", False))
+    variances = [float(v) for v in
+                 ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr("step_w", 0.0)) or IW / W
+    step_h = float(ctx.attr("step_h", 0.0)) or IH / H
+    offset = float(ctx.attr("offset", 0.5))
+
+    # ExpandAspectRatios: 1.0 first, then each ratio (+ flip), deduped
+    ratios = [1.0]
+    for ar in ars:
+        if all(abs(ar - r) > 1e-6 for r in ratios):
+            ratios.append(ar)
+            if flip:
+                ratios.append(1.0 / ar)
+
+    whs = []  # (w, h) per prior, reference ordering
+    for k, ms in enumerate(min_sizes):
+        for ar in ratios:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if abs(ar - 1.0) < 1e-6 and max_sizes:
+                big = np.sqrt(ms * max_sizes[k])
+                whs.append((big, big))
+    P = len(whs)
+    wh = jnp.asarray(np.array(whs, np.float32))          # [P, 2]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                      # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    half_w = wh[None, None, :, 0] / 2
+    half_h = wh[None, None, :, 1] / 2
+    boxes = jnp.stack(
+        [(cxg - half_w) / IW, (cyg - half_h) / IH,
+         (cxg + half_w) / IW, (cyg + half_h) / IH], axis=-1)  # [H,W,P,4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (H, W, P, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("box_coder", grad="none")
+def box_coder(ctx: ExecContext):
+    """Center-size box encode/decode (reference box_coder_op.h).
+    PriorBox [M, 4], PriorBoxVar [M, 4]?, TargetBox encode:[N, 4] /
+    decode:[N, M, 4]. code_type attr: encode_center_size|decode_center_size.
+    """
+    prior = ctx.input("PriorBox")
+    pvar = ctx.input("PriorBoxVar")
+    target = ctx.input("TargetBox")
+    code_type = str(ctx.attr("code_type", "encode_center_size"))
+    norm = bool(ctx.attr("box_normalized", True))
+
+    eps = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + eps
+    ph = prior[:, 3] - prior[:, 1] + eps
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + eps
+        th = target[:, 3] - target[:, 1] + eps
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        # broadcast [N, 1] vs [1, M]
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / pvar[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / pvar[None, :, 3]
+        return {"OutputBox": jnp.stack([ox, oy, ow, oh], axis=-1)}
+
+    # decode: target [N, M, 4] offsets -> boxes
+    ox = target[..., 0] * pvar[None, :, 0] * pw[None, :] + pcx[None, :]
+    oy = target[..., 1] * pvar[None, :, 1] * ph[None, :] + pcy[None, :]
+    ow = jnp.exp(target[..., 2] * pvar[None, :, 2]) * pw[None, :]
+    oh = jnp.exp(target[..., 3] * pvar[None, :, 3]) * ph[None, :]
+    return {"OutputBox": jnp.stack(
+        [ox - ow / 2, oy - oh / 2, ox + ow / 2 - eps, oy + oh / 2 - eps],
+        axis=-1)}
+
+
+def _iou(a, b, eps=0.0):
+    """Pairwise IoU: a [N, 4], b [M, 4] -> [N, M]. eps=1.0 applies the
+    reference's +1 width/height convention for UNnormalized pixel boxes
+    (bbox_util.h JaccardOverlap)."""
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.clip(x2 - x1 + eps, 0) * jnp.clip(y2 - y1 + eps, 0)
+    area_a = (jnp.clip(a[:, 2] - a[:, 0] + eps, 0)
+              * jnp.clip(a[:, 3] - a[:, 1] + eps, 0))
+    area_b = (jnp.clip(b[:, 2] - b[:, 0] + eps, 0)
+              * jnp.clip(b[:, 3] - b[:, 1] + eps, 0))
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", grad="none")
+def iou_similarity(ctx: ExecContext):
+    """reference iou_similarity_op.h: X [N, 4], Y [M, 4] -> [N, M]."""
+    return {"Out": _iou(ctx.input("X"), ctx.input("Y"))}
+
+
+def _nms_single(scores, base_iou, score_thr, nms_thr, top_k):
+    """Greedy NMS over one class: scores [M], base_iou [M, M] (shared
+    across classes — the boxes don't change per class) -> keep mask [M]
+    (top_k-bounded), computed as a scan over the score-sorted candidates."""
+    order = jnp.argsort(-scores)
+    ss = scores[order]
+    M = scores.shape[0]
+    iou = base_iou[order][:, order]
+
+    def step(kept, i):
+        valid = (ss[i] > score_thr) & (jnp.sum(kept) < top_k)
+        sup = jnp.any(kept & (iou[i] > nms_thr))
+        keep_i = valid & ~sup
+        return kept.at[i].set(keep_i), None
+
+    kept, _ = jax.lax.scan(step, jnp.zeros((M,), bool), jnp.arange(M))
+    # map back to original order
+    inv = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M))
+    return kept[inv]
+
+
+@register_op("multiclass_nms", grad="none")
+def multiclass_nms(ctx: ExecContext):
+    """reference multiclass_nms_op.cc on fixed shapes.
+
+    BBoxes [N, M, 4], Scores [N, C, M]. Per class: score threshold + greedy
+    IoU NMS (nms_top_k); across classes: keep_top_k by score. Output
+    [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), label = -1 marks
+    padding (the reference's empty-LoD convention)."""
+    bboxes = ctx.input("BBoxes")
+    scores = ctx.input("Scores")
+    score_thr = float(ctx.attr("score_threshold", 0.0))
+    nms_thr = float(ctx.attr("nms_threshold", 0.3))
+    nms_top_k = int(ctx.attr("nms_top_k", 400))
+    keep_top_k = int(ctx.attr("keep_top_k", 200))
+    bg = int(ctx.attr("background_label", 0))
+    normalized = bool(ctx.attr("normalized", True))
+    N, C, M = scores.shape
+    if keep_top_k < 0:
+        keep_top_k = C * M
+
+    def per_image(bx, sc):
+        base_iou = _iou(bx, bx, eps=0.0 if normalized else 1.0)
+        all_scores, all_labels, all_boxes = [], [], []
+        for c in range(C):
+            if c == bg:
+                continue
+            keep = _nms_single(sc[c], base_iou, score_thr, nms_thr,
+                               nms_top_k)
+            all_scores.append(jnp.where(keep, sc[c], -1.0))
+            all_labels.append(jnp.full((M,), c, jnp.float32))
+            all_boxes.append(bx)
+        fs = jnp.concatenate(all_scores)
+        fl = jnp.concatenate(all_labels)
+        fb = jnp.concatenate(all_boxes)
+        k = min(keep_top_k, fs.shape[0])
+        top_s, top_i = jax.lax.top_k(fs, k)
+        rows = jnp.concatenate(
+            [jnp.where(top_s > score_thr, fl[top_i], -1.0)[:, None],
+             top_s[:, None], fb[top_i]], axis=1)
+        if k < keep_top_k:
+            pad = jnp.full((keep_top_k - k, 6), -1.0, rows.dtype)
+            rows = jnp.concatenate([rows, pad], axis=0)
+        return rows
+
+    return {"Out": jax.vmap(per_image)(bboxes, scores)}

@@ -342,3 +342,51 @@ def dgc(ctx: ExecContext):
     v = _jnp.where(mask, 0, v)
     u = _jnp.where(mask, 0, u)
     return {"GradOut": grad_out, "UOut": u, "VOut": v}
+
+
+@register_op("model_average_accum", grad="none",
+             stateful_outputs=("SumOut", "CntOut"))
+def model_average_accum(ctx: ExecContext):
+    """Sliding-window parameter accumulation (reference ModelAverage
+    optimizer.py:2263, simplified three-sum rotation to one sum + count with
+    max-window truncation — same average on the valid window)."""
+    import jax.numpy as _jnp
+
+    p = ctx.input("Param")
+    s = ctx.input("Sum")
+    cnt = ctx.input("Cnt")
+    total = ctx.input("TotalUpdates")
+    max_w = float(ctx.attr("max_average_window", 10000))
+    min_w = float(ctx.attr("min_average_window", 10000))
+    rate = float(ctx.attr("average_window_rate", 0.15))
+    # reference window rule: truncate when the window exceeds
+    # clip(total_updates * rate, min_window, max_window)
+    if total is None:
+        limit = max_w
+    else:
+        limit = _jnp.clip(total.reshape(()) * rate, min_w, max_w)
+    cnt2 = cnt + 1.0
+    reset = cnt2 > limit
+    s2 = _jnp.where(reset, p, s + p)
+    cnt2 = _jnp.where(reset, 1.0, cnt2)
+    return {"SumOut": s2, "CntOut": cnt2}
+
+
+@register_op("lookahead", grad="none",
+             stateful_outputs=("ParamOut", "SlowOut"))
+def lookahead(ctx: ExecContext):
+    """Lookahead slow/fast sync (reference LookaheadOptimizer
+    optimizer.py:2976, arXiv:1907.08610): every k steps
+    slow += alpha*(fast-slow); fast = slow. Step is incremented ONCE by a
+    separate increment op so every parameter syncs on the same tick."""
+    import jax.numpy as _jnp
+
+    fast = ctx.input("Param")
+    slow = ctx.input("SlowParam")
+    step = ctx.input("Step").reshape(())
+    alpha = float(ctx.attr("alpha", 0.5))
+    k = float(ctx.attr("k", 5))
+    sync = _jnp.mod(step, k) == 0.0
+    new_slow = _jnp.where(sync, slow + alpha * (fast - slow), slow)
+    new_fast = _jnp.where(sync, new_slow.astype(fast.dtype), fast)
+    return {"ParamOut": new_fast, "SlowOut": new_slow}

@@ -47,6 +47,8 @@ __all__ = [
     "LambOptimizer",
     "LarsMomentum",
     "LarsMomentumOptimizer",
+    "ModelAverage",
+    "LookaheadOptimizer",
     "ExponentialMovingAverage",
 ]
 
@@ -714,6 +716,126 @@ class LambOptimizer(Optimizer):
                 "weight_decay": self._weight_decay,
             },
         )
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference optimizer.py:2263).
+
+    Construct AFTER the training optimizer's minimize(): accumulation ops
+    append to the main program; `with model_average.apply(exe):` swaps
+    parameters for their window averages (restored on exit, or call
+    restore()). The reference's three-sum rotation collapses to one
+    sum+count with max-window truncation — identical averages over the
+    active window.
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = list(default_main_program().all_parameters())
+        self.helper = LayerHelper(self.__class__.__name__)
+        total = self.helper.create_or_get_global_variable(
+            unique_name.generate("ma_total_updates"), [1], "float32",
+            initializer=Constant(0.0))
+        default_main_program().global_block.append_op(
+            "increment", {"X": [total.name]}, {"Out": [total.name]},
+            {"step": 1.0})
+        for p in self._params:
+            s = self._add_accumulator("ma_sum", p)
+            c = self._add_accumulator("ma_cnt", p, shape=[1])
+            default_main_program().global_block.append_op(
+                "model_average_accum",
+                inputs={"Param": [p.name], "Sum": [s.name], "Cnt": [c.name],
+                        "TotalUpdates": [total.name]},
+                outputs={"SumOut": [s.name], "CntOut": [c.name]},
+                attrs={"max_average_window": float(max_average_window),
+                       "min_average_window": float(min_average_window),
+                       "average_window_rate": float(average_window_rate)},
+            )
+
+    def _swap(self, executor, to_average: bool):
+        import jax.numpy as jnp
+
+        from .executor import global_scope
+
+        scope = global_scope()
+        for p in self._params:
+            if to_average:
+                s = np.asarray(scope.find_var(
+                    self._accumulators["ma_sum"][p.name].name))
+                c = float(np.asarray(scope.find_var(
+                    self._accumulators["ma_cnt"][p.name].name)).reshape(-1)[0])
+                self._backup[p.name] = scope.find_var(p.name)
+                if c > 0:
+                    scope.set_var(p.name, jnp.asarray(s / c, s.dtype))
+            else:
+                scope.set_var(p.name, self._backup[p.name])
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self._backup = {}
+            self._swap(executor, True)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self._swap(executor, False)
+
+        return guard()
+
+    def restore(self, executor=None):
+        self._swap(executor, False)
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (reference optimizer.py:2976, arXiv:1907.08610):
+    the inner optimizer updates fast weights every step; every k steps the
+    slow weights catch up and overwrite the fast ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        assert 0.0 <= alpha <= 1.0, "alpha should be in [0.0, 1.0]"
+        assert isinstance(k, int) and k > 0, "k should be a positive integer"
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pgs = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        helper = LayerHelper("lookahead")
+        block = default_main_program().global_block
+        step = helper.create_or_get_global_variable(
+            unique_name.generate("lookahead_step"), [1], "float32",
+            initializer=Constant(0.0))
+        # increment ONCE, then every parameter's sync op reads the same tick
+        block.append_op("increment", {"X": [step.name]},
+                        {"Out": [step.name]}, {"step": 1.0})
+        for p, g in pgs:
+            if g is None:
+                continue
+            slow = helper.create_or_get_global_variable(
+                unique_name.generate(p.name + "_slow"), list(p.shape),
+                "float32", initializer=None)
+            # slow starts equal to fast: copy in the startup program
+            default_startup_program().global_block.append_op(
+                "assign", {"X": [p.name]}, {"Out": [slow.name]}, {})
+            block.append_op(
+                "lookahead",
+                inputs={"Param": [p.name], "SlowParam": [slow.name],
+                        "Step": [step.name]},
+                outputs={"ParamOut": [p.name], "SlowOut": [slow.name]},
+                attrs={"alpha": self.alpha, "k": float(self.k)},
+            )
+        return ops, pgs
 
 
 class ExponentialMovingAverage:

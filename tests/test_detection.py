@@ -1,0 +1,87 @@
+"""Detection ops: prior_box geometry, box_coder round trip, IoU values,
+multiclass NMS suppression (reference detection/ op family semantics on
+fixed shapes)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def test_prior_box_count_and_geometry():
+    feat = L.data(name="feat", shape=[8, 2, 2], dtype="float32")
+    img = L.data(name="img", shape=[3, 32, 32], dtype="float32")
+    boxes, var = L.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                             aspect_ratios=[2.0], flip=True)
+    exe = pt.Executor()
+    (b, v) = exe.run(
+        pt.default_main_program(),
+        feed={"feat": np.zeros((1, 8, 2, 2), np.float32),
+              "img": np.zeros((1, 3, 32, 32), np.float32)},
+        fetch_list=[boxes, var])
+    # priors per cell: min(ratio 1) + sqrt(min*max) + ratio 2 + ratio 1/2
+    assert b.shape == (2, 2, 4, 4)
+    assert v.shape == b.shape
+    # first prior at cell (0,0): center (0.5*16, 0.5*16)=(8,8), 8x8 box
+    np.testing.assert_allclose(
+        b[0, 0, 0], [4 / 32, 4 / 32, 12 / 32, 12 / 32], atol=1e-6)
+    # sqrt box: sqrt(8*16) ~ 11.31
+    s = np.sqrt(8.0 * 16.0) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 1], [(8 - s) / 32, (8 - s) / 32, (8 + s) / 32, (8 + s) / 32],
+        atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.default_rng(0)
+    priors = np.array([[0.1, 0.1, 0.4, 0.5], [0.3, 0.2, 0.9, 0.8]],
+                      np.float32)
+    gts = np.array([[0.15, 0.12, 0.45, 0.47]], np.float32)
+    pb = L.data(name="pb", shape=[4], dtype="float32")
+    pb.shape = (2, 4)
+    gt = L.data(name="gt", shape=[4], dtype="float32")
+    gt.shape = (1, 4)
+    enc = L.box_coder(pb, None, gt, code_type="encode_center_size")
+    dec = L.box_coder(pb, None, enc, code_type="decode_center_size")
+    exe = pt.Executor()
+    e, d = exe.run(pt.default_main_program(),
+                   feed={"pb": priors, "gt": gts}, fetch_list=[enc, dec])
+    assert e.shape == (1, 2, 4)
+    # decoding the encoding against the same priors returns the gt box
+    np.testing.assert_allclose(d[0, 0], gts[0], atol=1e-5)
+    np.testing.assert_allclose(d[0, 1], gts[0], atol=1e-5)
+
+
+def test_iou_similarity_values():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [5, 5, 6, 6]], np.float32)
+    x = L.data(name="x", shape=[4], dtype="float32")
+    x.shape = (1, 4)
+    y = L.data(name="y", shape=[4], dtype="float32")
+    y.shape = (3, 4)
+    out = L.iou_similarity(x, y)
+    exe = pt.Executor()
+    (got,) = exe.run(pt.default_main_program(), feed={"x": a, "y": b},
+                     fetch_list=[out])
+    np.testing.assert_allclose(got[0], [1.0, 1.0 / 7.0, 0.0], rtol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # two near-identical boxes + one distant; NMS keeps the best of the
+    # pair and the distant one
+    boxes = np.array([[[0.1, 0.1, 0.4, 0.4],
+                       [0.11, 0.11, 0.41, 0.41],
+                       [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    scores = np.array([[[0.0, 0.0, 0.0],        # background
+                        [0.9, 0.8, 0.7]]], np.float32)  # class 1
+    bb = L.data(name="bb", shape=[3, 4], dtype="float32")
+    sc = L.data(name="sc", shape=[2, 3], dtype="float32")
+    out = L.multiclass_nms(bb, sc, score_threshold=0.1, nms_top_k=10,
+                           keep_top_k=3, nms_threshold=0.5)
+    exe = pt.Executor()
+    (got,) = exe.run(pt.default_main_program(),
+                     feed={"bb": boxes, "sc": scores}, fetch_list=[out])
+    labels = got[0, :, 0]
+    kept = labels >= 0
+    assert kept.sum() == 2, got[0]
+    kept_scores = sorted(got[0, kept, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.7], rtol=1e-5)

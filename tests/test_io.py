@@ -114,3 +114,53 @@ def test_inference_model_mid_graph_feed(tmp_path):
         hv = np.abs(np.random.default_rng(0).standard_normal((3, 4))).astype(np.float32)
         (got,) = exe.run(prog, feed={h.name: hv}, fetch_list=fetches)
     assert got.shape == (3, 1)
+
+
+def test_sharded_checkpoint_roundtrip_on_mesh(tmp_path):
+    """save_sharded/load_sharded round-trips params + ZeRO-sharded optimizer
+    state over the 8-device mesh without a host-0 gather (SURVEY §5)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers as L
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    x = L.data(name="x", shape=[16], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=8, name="s"), y))
+    pt.optimizer.Adam(0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    xb = rng.standard_normal((16, 16)).astype(np.float32)
+    yb = rng.standard_normal((16, 1)).astype(np.float32)
+    exe.run(pt.default_main_program(), feed={"x": xb, "y": yb},
+            fetch_list=[loss])
+
+    # shard one var over the mesh to prove sharded arrays round-trip
+    mesh = make_mesh({"dp": 8})
+    scope = pt.global_scope()
+    w = np.asarray(scope.find_var("s.w_0"))
+    sharded = jax.device_put(w, NamedSharding(mesh, P("dp", None)))
+    scope.set_var("s.w_0", sharded)
+
+    before = {n: np.asarray(scope.find_var(n))
+              for n in scope.var_names()}
+    pt.io.save_sharded(exe, str(tmp_path / "ckpt"))
+
+    for n in list(scope.var_names()):
+        scope.set_var(n, np.zeros_like(before[n]))
+    pt.io.load_sharded(exe, str(tmp_path / "ckpt"))
+    for n, v in before.items():
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var(n)), v, rtol=1e-6,
+            err_msg=f"var {n} did not round-trip")
+
+    # resharding-on-load: place the weight over a different axis layout
+    pt.io.load_sharded(
+        exe, str(tmp_path / "ckpt"),
+        shardings={"s.w_0": NamedSharding(mesh, P(None, "dp"))})
+    got = scope.find_var("s.w_0")
+    assert got.sharding.spec == P(None, "dp")
+    np.testing.assert_allclose(np.asarray(got), before["s.w_0"], rtol=1e-6)

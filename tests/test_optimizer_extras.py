@@ -1,0 +1,33 @@
+"""ModelAverage + LookaheadOptimizer (reference optimizer.py:2263, :2976)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def test_model_average_and_lookahead():
+    x = L.data(name="x", shape=[6], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1, name="f"), y))
+    pt.optimizer.LookaheadOptimizer(
+        pt.optimizer.SGD(0.05), alpha=0.5, k=4).minimize(loss)
+    ma = pt.optimizer.ModelAverage(0.15, max_average_window=20)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((6, 1)).astype(np.float32)
+    first = last = None
+    for i in range(40):
+        xb = rng.standard_normal((16, 6)).astype(np.float32)
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.5
+    cur = np.asarray(pt.global_scope().find_var("f.w_0")).copy()
+    with ma.apply(exe):
+        avg = np.asarray(pt.global_scope().find_var("f.w_0")).copy()
+    back = np.asarray(pt.global_scope().find_var("f.w_0"))
+    assert not np.allclose(avg, cur)      # averaged weights differ
+    np.testing.assert_allclose(back, cur)  # restored on exit
