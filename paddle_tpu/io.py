@@ -286,12 +286,10 @@ def load_sharded(executor=None, dirname="", main_program=None, scope=None,
     # orbax's restore raises on tree mismatches
     path = os.path.abspath(dirname)
     ckptr = ocp.StandardCheckpointer()
-    try:
-        saved_keys = set(ckptr.metadata(path).keys())
-    except Exception:
-        saved_keys = None  # older layout: fall through to full tree
-    if saved_keys is not None:
-        names = [n for n in names if _encode_name(n) in saved_keys]
+    # restore targets must match the on-disk tree exactly, so read the saved
+    # key set from the checkpoint metadata
+    saved_keys = set(ckptr.metadata(path).item_metadata.keys())
+    names = [n for n in names if _encode_name(n) in saved_keys]
     # abstract restore targets: shape/dtype from the program, placement from
     # `shardings` / current scope values
     target = {}

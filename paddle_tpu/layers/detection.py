@@ -89,5 +89,5 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     decoded = box_coder(prior_box, prior_box_var, loc,
                         code_type="decode_center_size")
     return multiclass_nms(decoded, scores, score_threshold, nms_top_k,
-                          keep_top_k, nms_threshold,
+                          keep_top_k, nms_threshold, nms_eta=nms_eta,
                           background_label=background_label)

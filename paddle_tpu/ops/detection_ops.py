@@ -141,23 +141,28 @@ def iou_similarity(ctx: ExecContext):
 
 def _nms_single(scores, base_iou, score_thr, nms_thr, top_k):
     """Greedy NMS over one class: scores [M], base_iou [M, M] (shared
-    across classes — the boxes don't change per class) -> keep mask [M]
-    (top_k-bounded), computed as a scan over the score-sorted candidates."""
-    order = jnp.argsort(-scores)
-    ss = scores[order]
+    across classes — the boxes don't change per class) -> keep mask [M].
+
+    Reference NMSFast semantics: the candidate POOL is the top nms_top_k by
+    score (lower-ranked boxes are never considered), then greedy IoU
+    suppression over that pool — which also bounds the sequential scan to
+    top_k steps instead of M."""
     M = scores.shape[0]
+    k = min(int(top_k), M) if top_k > 0 else M
+    order = jnp.argsort(-scores)[:k]
+    ss = scores[order]
     iou = base_iou[order][:, order]
 
     def step(kept, i):
-        valid = (ss[i] > score_thr) & (jnp.sum(kept) < top_k)
+        valid = ss[i] > score_thr
         sup = jnp.any(kept & (iou[i] > nms_thr))
         keep_i = valid & ~sup
         return kept.at[i].set(keep_i), None
 
-    kept, _ = jax.lax.scan(step, jnp.zeros((M,), bool), jnp.arange(M))
-    # map back to original order
-    inv = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M))
-    return kept[inv]
+    kept, _ = jax.lax.scan(step, jnp.zeros((k,), bool), jnp.arange(k))
+    # scatter the pool's keep decisions back to original positions
+    full = jnp.zeros((M,), bool)
+    return full.at[order].set(kept)
 
 
 @register_op("multiclass_nms", grad="none")

@@ -59,14 +59,17 @@ def conv2d_transpose(ctx: ExecContext):
     strides = _pair(ctx.attr("strides", [1, 1]))
     p = _pair(ctx.attr("paddings", [0, 0]))
     d = _pair(ctx.attr("dilations", [1, 1]))
-    # filter layout for transpose in the reference is (C_in, C_out, H, W)
+    # filter layout for transpose in the reference is (C_in, C_out, H, W).
+    # With transpose_kernel=True jax swaps the kernel's I/O axes and flips
+    # its spatial dims, so the spec must name dim 0 "O" and dim 1 "I" for
+    # the post-swap conv to contract C_in against the input.
     out = jax.lax.conv_transpose(
         x,
         w,
         strides=strides,
         padding=[(p[0], p[0]), (p[1], p[1])],
         rhs_dilation=d,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
     ).astype(x.dtype)
     return {"Output": out}

@@ -1,0 +1,227 @@
+"""OpTest coverage for ops previously riding on vjp faith (VERDICT weak #8):
+conv2d_transpose, group/instance_norm, scatter/gather_nd, strided_slice,
+sequence ops' gradients, and numpy-trajectory checks for the long-tail
+optimizers (Ftrl, Adadelta, DecayedAdagrad, RMSProp)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+from op_test import OpTest
+
+
+class TestConv2DTranspose(OpTest):
+    def test_output_and_grad(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+        w = rng.standard_normal((3, 5, 3, 3)).astype(np.float32)  # I, O, kh, kw
+
+        # numpy reference: scatter each input pixel times the kernel
+        N, C, H, W = x.shape
+        _, O, kh, kw = w.shape
+        stride, pad = 2, 1
+        OH = (H - 1) * stride - 2 * pad + kh
+        OW = (W - 1) * stride - 2 * pad + kw
+        full = np.zeros((N, O, OH + 2 * pad, OW + 2 * pad), np.float32)
+        for n in range(N):
+            for c in range(C):
+                for i in range(H):
+                    for j in range(W):
+                        full[n, :, i * stride:i * stride + kh,
+                             j * stride:j * stride + kw] += (
+                            x[n, c, i, j] * w[c])
+        expect = full[:, :, pad:pad + OH, pad:pad + OW]
+
+        self.setup("conv2d_transpose",
+                   {"Input": [("x", x)], "Filter": [("w", w)]},
+                   {"Output": expect},
+                   {"strides": [stride, stride], "paddings": [pad, pad]})
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["x", "w"], "Output", max_relative_error=1e-2)
+
+
+class TestGroupNorm(OpTest):
+    def test_output_and_grad(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 6, 3, 3)).astype(np.float32)
+        scale = rng.standard_normal(6).astype(np.float32)
+        bias = rng.standard_normal(6).astype(np.float32)
+        G, eps = 3, 1e-5
+        xr = x.reshape(2, G, 2, 3, 3)
+        mean = xr.mean(axis=(2, 3, 4), keepdims=True)
+        var = xr.var(axis=(2, 3, 4), keepdims=True)
+        norm = ((xr - mean) / np.sqrt(var + eps)).reshape(x.shape)
+        expect = norm * scale[None, :, None, None] + bias[None, :, None, None]
+        self.setup("group_norm",
+                   {"X": [("x", x)], "Scale": [("scale", scale)],
+                    "Bias": [("bias", bias)]},
+                   {"Y": expect}, {"groups": G, "epsilon": eps})
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["x", "scale", "bias"], "Y",
+                        max_relative_error=1e-2)
+
+
+class TestInstanceNorm(OpTest):
+    def test_output(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 4, 5, 5)).astype(np.float32)
+        scale = np.abs(rng.standard_normal(4)).astype(np.float32)
+        bias = rng.standard_normal(4).astype(np.float32)
+        eps = 1e-5
+        mean = x.mean(axis=(2, 3), keepdims=True)
+        var = x.var(axis=(2, 3), keepdims=True)
+        expect = ((x - mean) / np.sqrt(var + eps)
+                  * scale[None, :, None, None] + bias[None, :, None, None])
+        self.setup("instance_norm",
+                   {"X": [("x", x)], "Scale": [("scale", scale)],
+                    "Bias": [("bias", bias)]},
+                   {"Y": expect}, {"epsilon": eps})
+        self.check_output(atol=1e-4, rtol=1e-4)
+        # no numeric grad check: sum(instance_norm(x)) is constant in x
+        # (each channel's normalized values sum to 0), so the harness's
+        # sum-reduced target has an identically-zero, degenerate gradient
+
+
+class TestScatter(OpTest):
+    def test_overwrite_and_grad(self):
+        x = np.arange(20, dtype=np.float32).reshape(5, 4)
+        idx = np.array([1, 3], np.int64)
+        upd = -np.ones((2, 4), np.float32)
+        expect = x.copy()
+        expect[idx] = upd
+        self.setup("scatter",
+                   {"X": [("x", x)], "Ids": [("ids", idx)],
+                    "Updates": [("upd", upd)]},
+                   {"Out": expect}, {"overwrite": True})
+        self.check_output()
+        self.check_grad(["x", "upd"], "Out", no_grad_set={"ids"})
+
+
+class TestGatherNd(OpTest):
+    def test_output_and_grad(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((3, 4, 5)).astype(np.float32)
+        idx = np.array([[0, 1], [2, 3]], np.int64)
+        expect = x[idx[:, 0], idx[:, 1]]
+        self.setup("gather_nd",
+                   {"X": [("x", x)], "Index": [("idx", idx)]},
+                   {"Out": expect}, {})
+        self.check_output()
+        self.check_grad(["x"], "Out", no_grad_set={"idx"})
+
+
+class TestStridedSlice(OpTest):
+    def test_output_and_grad(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        expect = x[1:4:2, 6:0:-2]
+        self.setup("strided_slice", {"Input": [("x", x)]},
+                   {"Out": expect},
+                   {"axes": [0, 1], "starts": [1, 6], "ends": [4, 0],
+                    "strides": [2, -2]})
+        self.check_output()
+        self.check_grad(["x"], "Out")
+
+
+class TestSequencePoolGrad(OpTest):
+    def test_average_grad(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((3, 4, 2)).astype(np.float32)
+        lens = np.array([2, 4, 3], np.int64)
+        mask = (np.arange(4)[None, :] < lens[:, None]).astype(np.float32)
+        expect = (x * mask[..., None]).sum(1) / lens[:, None]
+        self.setup("sequence_pool",
+                   {"X": [("x", x)], "Length": [("len", lens)]},
+                   {"Out": expect}, {"pooltype": "AVERAGE"})
+        self.check_output()
+        self.check_grad(["x"], "Out", no_grad_set={"len"})
+
+
+def _run_optimizer_trajectory(make_opt, np_update, steps=5):
+    """Train one fc param; compare against a numpy re-implementation."""
+    x = L.data(name="x", shape=[4], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    pred = L.fc(x, size=1, name="t", bias_attr=False)
+    loss = L.mean(L.square_error_cost(pred, y))
+    make_opt().minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    w = np.asarray(pt.global_scope().find_var("t.w_0")).astype(np.float64)
+    state = {}
+    for _ in range(steps):
+        xb = rng.standard_normal((8, 4)).astype(np.float32)
+        yb = rng.standard_normal((8, 1)).astype(np.float32)
+        # analytic grad of mean((xw - y)^2): 2/B * x^T (xw - y)
+        g = (2.0 / len(xb)) * xb.T.astype(np.float64) @ (
+            xb.astype(np.float64) @ w - yb.astype(np.float64)) / 1.0
+        w = np_update(w, g, state)
+        exe.run(pt.default_main_program(), feed={"x": xb, "y": yb},
+                fetch_list=[loss])
+    got = np.asarray(pt.global_scope().find_var("t.w_0"))
+    np.testing.assert_allclose(got, w, rtol=2e-4, atol=2e-5)
+
+
+def test_ftrl_matches_numpy():
+    lr, l1, l2, power = 0.05, 0.01, 0.02, -0.5
+
+    def update(w, g, s):
+        sq = s.setdefault("sq", np.zeros_like(w))
+        lin = s.setdefault("lin", np.zeros_like(w))
+        new_sq = sq + g * g
+        sigma = (new_sq ** -power - sq ** -power) / lr
+        lin += g - sigma * w
+        s["sq"] = new_sq
+        pre = new_sq ** -power / lr + 2 * l2
+        w_new = np.where(np.abs(lin) > l1,
+                         (np.sign(lin) * l1 - lin) / pre, 0.0)
+        return w_new
+
+    _run_optimizer_trajectory(
+        lambda: pt.optimizer.Ftrl(lr, l1=l1, l2=l2, lr_power=power), update)
+
+
+def test_adadelta_matches_numpy():
+    lr, rho, eps = 1.0, 0.95, 1e-6
+
+    def update(w, g, s):
+        ag = s.setdefault("ag", np.zeros_like(w))
+        ax = s.setdefault("ax", np.zeros_like(w))
+        ag = rho * ag + (1 - rho) * g * g
+        dx = -np.sqrt((ax + eps) / (ag + eps)) * g
+        ax = rho * ax + (1 - rho) * dx * dx
+        s["ag"], s["ax"] = ag, ax
+        return w + lr * dx
+
+    _run_optimizer_trajectory(
+        lambda: pt.optimizer.Adadelta(lr, epsilon=eps, rho=rho), update)
+
+
+def test_decayed_adagrad_matches_numpy():
+    lr, decay, eps = 0.05, 0.9, 1e-6
+
+    def update(w, g, s):
+        m = s.setdefault("m", np.zeros_like(w))
+        m = decay * m + (1 - decay) * g * g
+        s["m"] = m
+        return w - lr * g / (np.sqrt(m) + eps)
+
+    _run_optimizer_trajectory(
+        lambda: pt.optimizer.DecayedAdagrad(lr, decay=decay, epsilon=eps),
+        update)
+
+
+def test_rmsprop_matches_numpy():
+    lr, rho, eps, mom = 0.01, 0.95, 1e-6, 0.9
+
+    def update(w, g, s):
+        ms = s.setdefault("ms", np.zeros_like(w))
+        v = s.setdefault("v", np.zeros_like(w))
+        ms = rho * ms + (1 - rho) * g * g
+        v = mom * v + lr * g / np.sqrt(ms + eps)
+        s["ms"], s["v"] = ms, v
+        return w - v
+
+    _run_optimizer_trajectory(
+        lambda: pt.optimizer.RMSProp(lr, rho=rho, epsilon=eps, momentum=mom),
+        update)
