@@ -287,9 +287,14 @@ def load_sharded(executor=None, dirname="", main_program=None, scope=None,
     path = os.path.abspath(dirname)
     ckptr = ocp.StandardCheckpointer()
     # restore targets must match the on-disk tree exactly, so read the saved
-    # key set from the checkpoint metadata
-    saved_keys = set(ckptr.metadata(path).item_metadata.keys())
-    names = [n for n in names if _encode_name(n) in saved_keys]
+    # key set from the checkpoint metadata; a layout whose metadata can't be
+    # read falls back to the full program tree (which still restores when
+    # the trees happen to match)
+    try:
+        saved_keys = set(ckptr.metadata(path).item_metadata.keys())
+        names = [n for n in names if _encode_name(n) in saved_keys]
+    except (AttributeError, ValueError, KeyError, FileNotFoundError):
+        pass
     # abstract restore targets: shape/dtype from the program, placement from
     # `shardings` / current scope values
     target = {}

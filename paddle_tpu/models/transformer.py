@@ -155,6 +155,14 @@ def _encoder_layer(x, cfg: TransformerConfig, attn_bias, name):
                         name=name + ".ln2")
 
 
+# per-layer outputs of the MOST RECENT transformer_encoder build — the
+# natural checkpoint set for RecomputeOptimizer._set_checkpoints. Snapshot
+# it (list(...)) right after the build: a second encoder build (eval tower,
+# second program) overwrites it, and _set_checkpoints with stale vars from a
+# different program fails loudly at minimize().
+last_layer_outputs: list = []
+
+
 def transformer_encoder(src_ids, pos_ids, cfg: TransformerConfig,
                         input_mask=None, name="encoder"):
     """Token+position embedding -> N encoder layers. Returns [B,S,H]."""
@@ -177,8 +185,10 @@ def transformer_encoder(src_ids, pos_ids, cfg: TransformerConfig,
         neg = L.scale(neg, scale=-1e9)
         attn_bias = L.unsqueeze(L.unsqueeze(neg, axes=[1]), axes=[1])
 
+    last_layer_outputs.clear()
     for i in range(cfg.num_layers):
         x = _encoder_layer(x, cfg, attn_bias, name=f"{name}.layer{i}")
+        last_layer_outputs.append(x)
     return x
 
 

@@ -199,3 +199,37 @@ def switch_case(ctx: ExecContext):
     for cond, vals in reversed(list(zip(conds, cased))):
         merged = [jnp.where(cond, v, m) for v, m in zip(vals, merged)]
     return {"Out": merged}
+
+
+@register_op("recompute", needs_rng=True)
+def recompute(ctx: ExecContext):
+    """Activation-recompute segment (reference RecomputeOptimizer lineage;
+    TPU-native design in optimizer.py RecomputeOptimizer).
+
+    inputs: Deps=[segment's external reads]; attrs: sub_block, dep_names,
+    out_names; outputs: Out=[segment results read after the segment].
+
+    Forward just runs the sub-block. The memory win happens in the derived
+    grad: `recompute_grad` replays this compute under jax.checkpoint (see
+    registry._make_vjp_grad_compute(remat=True)), so XLA rematerializes the
+    segment's intermediates in the backward pass instead of keeping them
+    live from the forward.
+    """
+    env = _outer_env(ctx)
+    key = _op_rng(ctx)
+    if key is not None:
+        env["__rng_key"] = key
+    env = ctx.lowerer(ctx.attr("sub_block"))(env)
+    out_names = list(ctx.attr("out_names"))
+    return {"Out": [jnp.asarray(env[n]) for n in out_names]}
+
+
+# the grad must NOT be the plain derived vjp (XLA would CSE the replay with
+# the forward and keep the activations anyway): register the remat variant
+from .registry import _REGISTRY, OpDef, _make_vjp_grad_compute  # noqa: E402
+
+_rc_grad = OpDef("recompute_grad",
+                 _make_vjp_grad_compute(_REGISTRY["recompute"], remat=True),
+                 no_grad=True)
+_rc_grad.derived_vjp = True
+_REGISTRY["recompute_grad"] = _rc_grad

@@ -177,7 +177,12 @@ def all_op_types():
 # ---------------------------------------------------------------------------
 
 
-def _make_vjp_grad_compute(fwd: OpDef):
+def _make_vjp_grad_compute(fwd: OpDef, remat: bool = False):
+    """remat=True wraps the forward replay in jax.checkpoint: XLA's CSE can
+    then NOT share it with the original forward (optimization_barrier), so
+    the segment's activations are genuinely recomputed in the backward pass
+    instead of kept live — the RecomputeOptimizer contract."""
+
     def grad_compute(ctx: ExecContext):
         op = ctx.op
         fwd_in_slots = [s for s in op.inputs if not s.endswith("@GRAD")]
@@ -225,7 +230,8 @@ def _make_vjp_grad_compute(fwd: OpDef):
             meta["widths"] = widths
             return tuple(flat)
 
-        outs_flat, vjp = jax.vjp(fwd_fn, *prims)
+        run_fwd = jax.checkpoint(fwd_fn) if remat else fwd_fn
+        outs_flat, vjp = jax.vjp(run_fwd, *prims)
         # cotangents: supplied @GRAD inputs; zeros for forward outputs the
         # backward pass never produced a grad for
         cots, idx = [], 0

@@ -49,6 +49,7 @@ __all__ = [
     "LarsMomentumOptimizer",
     "ModelAverage",
     "LookaheadOptimizer",
+    "RecomputeOptimizer",
     "ExponentialMovingAverage",
 ]
 
@@ -716,6 +717,144 @@ class LambOptimizer(Optimizer):
                 "weight_decay": self._weight_decay,
             },
         )
+
+
+class RecomputeOptimizer:
+    """Activation recompute / gradient checkpointing.
+
+    Reference lineage: the fleet DistributedStrategy forward_recompute flag
+    and the later fluid RecomputeOptimizer; the TPU-native mechanism here is
+    segment-level `jax.checkpoint`. `_set_checkpoints([vars])` names the
+    segment boundaries (typically each transformer layer's output); at
+    minimize() the forward block is split at those vars, each segment moves
+    into a sub-block behind one `recompute` op, and the derived
+    `recompute_grad` replays the segment under jax.checkpoint — XLA then
+    drops the segment's interior activations after the forward and
+    rematerializes them in the backward, trading ~1 extra forward of FLOPs
+    for O(#checkpoints) instead of O(#ops) live activation memory.
+
+        opt = RecomputeOptimizer(pt.optimizer.Adam(1e-4))
+        opt._set_checkpoints([layer1_out, layer2_out, ...])
+        opt.minimize(loss)
+
+    Constraint: RNG-consuming ops (dropout) inside a segment would draw
+    different numbers in the replay, so the rewrite rejects them.
+    """
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = []
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        self._rewrite(loss)
+        return self._inner.backward(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._inner.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        self._rewrite(loss)
+        return self._inner.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    # -- the program rewrite -------------------------------------------------
+    def _rewrite(self, loss):
+        from .ops.registry import get_op_def, has_op
+
+        if not self._checkpoints:
+            return
+        program = loss.block.program
+        block = program.global_block
+        ck_names = [getattr(c, "name", c) for c in self._checkpoints]
+        ck_set = set(ck_names)
+        if getattr(program, "_recompute_done", False):
+            return
+
+        # split the forward op list into segments ending at checkpoint defs
+        segments, cur = [], []
+        for op in block.ops:
+            cur.append(op)
+            if any(n in ck_set for n in op.output_names):
+                segments.append(cur)
+                cur = []
+        if cur:
+            segments.append(cur)  # tail (loss head) stays inline if short
+
+        new_ops = []
+        for si, seg in enumerate(segments[:-1]):
+            wrap = [op for op in seg if op.type not in ("feed", "fetch")]
+            passthrough = [op for op in seg if op.type in ("feed", "fetch")]
+            new_ops.extend(passthrough)
+            if len(wrap) < 2:
+                new_ops.extend(wrap)
+                continue
+            for op in wrap:
+                if has_op(op.type) and get_op_def(op.type).needs_rng:
+                    raise ValueError(
+                        f"RecomputeOptimizer: op '{op.type}' consumes RNG "
+                        "inside a recompute segment — its replay would draw "
+                        "different numbers. Move it out of the segment "
+                        "(e.g. dropout=0 under recompute).")
+            # names defined inside vs read from outside (insertion-ordered:
+            # slot ordering must not depend on PYTHONHASHSEED — program dumps
+            # and compile-cache keys have to be reproducible)
+            defined: dict = {}
+            ext_reads, outs = [], []
+            for op in wrap:
+                for n in op.input_names:
+                    if n and n not in defined and n not in ext_reads:
+                        ext_reads.append(n)
+                for n in op.output_names:
+                    if n:
+                        defined[n] = True
+            later_reads = {
+                n for later in segments[si + 1:] for op in later
+                for n in op.input_names if n}
+
+            def _persistable(n):
+                try:
+                    return block.var(n).persistable
+                except KeyError:
+                    return False
+
+            # persistable outputs (batch_norm running stats, counters) must
+            # surface even when nothing later reads them — the executor's
+            # scope write-back only scans top-level op outputs
+            outs = [n for n in defined
+                    if n in later_reads or n in ck_set or _persistable(n)]
+            # move the segment into a sub-block
+            sub = program._create_block()
+            for op in wrap:
+                sub.ops.append(op)
+                op.block = sub
+            program._rollback()
+            from .framework import Operator
+
+            rec = Operator(
+                block, "recompute",
+                {"Deps": list(ext_reads)},
+                {"Out": list(outs)},
+                {"sub_block": sub.idx,
+                 "dep_names": list(ext_reads),
+                 "out_names": list(outs)},
+            )
+            new_ops.append(rec)
+        new_ops.extend(segments[-1])
+        if not any(op.type == "recompute" for op in new_ops):
+            raise ValueError(
+                "RecomputeOptimizer: no checkpoint variable matched any op "
+                "output in this program — the checkpoints likely came from a "
+                "different program build (transformer.last_layer_outputs "
+                "holds the MOST RECENT build's vars)")
+        block.ops[:] = new_ops
+        program._recompute_done = True
+        program._bump_version()
 
 
 class ModelAverage(Optimizer):
