@@ -8,7 +8,7 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms",
-           "detection_output"]
+           "detection_output", "ssd_loss"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
@@ -91,3 +91,38 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     return multiclass_nms(decoded, scores, score_threshold, nms_top_k,
                           keep_top_k, nms_threshold, nms_eta=nms_eta,
                           background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             gt_count=None):
+    """reference detection.py:1280 — SSD multibox loss on padded ground
+    truth: gt_box [N, G, 4] + gt_label [N, G, 1] + optional gt_count [N]
+    valid rows (the LoD walk). Returns per-image loss [N, 1]."""
+    if match_type != "per_prediction" or mining_type != "max_negative":
+        raise NotImplementedError(
+            "ssd_loss supports match_type='per_prediction' with "
+            "mining_type='max_negative' (the reference defaults)")
+    if sample_size is not None:
+        raise NotImplementedError("ssd_loss: sample_size is not supported")
+    helper = LayerHelper("ssd_loss")
+    out = helper.create_variable_for_type_inference("float32")
+    ins = {"Loc": [location], "Conf": [confidence], "GTBox": [gt_box],
+           "GTLabel": [gt_label], "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    if gt_count is not None:
+        ins["GTCount"] = [gt_count]
+    helper.append_op(
+        "ssd_loss", ins, {"Loss": [out]},
+        {"background_label": int(background_label),
+         "overlap_threshold": float(overlap_threshold),
+         "neg_overlap": float(neg_overlap),
+         "neg_pos_ratio": float(neg_pos_ratio),
+         "loc_loss_weight": float(loc_loss_weight),
+         "conf_loss_weight": float(conf_loss_weight),
+         "normalize": bool(normalize)})
+    return out

@@ -210,3 +210,110 @@ def multiclass_nms(ctx: ExecContext):
         return rows
 
     return {"Out": jax.vmap(per_image)(bboxes, scores)}
+
+
+@register_op("ssd_loss")
+def ssd_loss(ctx: ExecContext):
+    """SSD multibox loss (reference detection.py:1280 ssd_loss pipeline,
+    collapsed into one fixed-shape op).
+
+    Inputs: Loc [N, M, 4] predicted offsets, Conf [N, M, C] raw logits,
+    GTBox [N, G, 4], GTLabel [N, G, 1] (0 padding rows marked by
+    GTCount [N] valid counts). PriorBox [M, 4], PriorBoxVar [M, 4]?.
+
+    Matching is per-prediction (each prior -> best gt when IoU >= threshold)
+    plus the bipartite guarantee that every valid gt claims its best prior —
+    the reference's two-phase match — followed by max-negative hard mining at
+    neg_pos_ratio. Returns Loss [N, 1].
+    """
+    loc = ctx.input("Loc")
+    conf = ctx.input("Conf")
+    gt_box = ctx.input("GTBox")
+    gt_label = ctx.input("GTLabel").reshape(gt_box.shape[0], -1)
+    gt_count = ctx.input("GTCount")
+    prior = ctx.input("PriorBox")
+    pvar = ctx.input("PriorBoxVar")
+    bg = int(ctx.attr("background_label", 0))
+    overlap_thr = float(ctx.attr("overlap_threshold", 0.5))
+    neg_overlap = float(ctx.attr("neg_overlap", 0.5))
+    neg_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    loc_w = float(ctx.attr("loc_loss_weight", 1.0))
+    conf_w = float(ctx.attr("conf_loss_weight", 1.0))
+    normalize = bool(ctx.attr("normalize", True))
+
+    N, M, C = conf.shape
+    G = gt_box.shape[1]
+    if gt_count is None:
+        gt_count = jnp.full((N,), G, jnp.int32)
+    else:
+        gt_count = gt_count.reshape(-1).astype(jnp.int32)
+    if pvar is None:
+        pvar = jnp.ones_like(prior)
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+
+    def per_image(bx, lbl, cnt, lc, cf):
+        valid_gt = jnp.arange(G) < cnt                      # [G]
+        iou = _iou(bx, prior)                               # [G, M]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        # phase 1 (bipartite seed): each valid gt claims its best prior
+        best_prior_per_gt = jnp.argmax(iou, axis=1)         # [G]
+        # phase 2 (per-prediction): each prior takes its best gt over thr
+        best_gt_per_prior = jnp.argmax(iou, axis=0)         # [M]
+        best_iou_per_prior = jnp.max(iou, axis=0)
+        matched_gt = jnp.where(best_iou_per_prior >= overlap_thr,
+                               best_gt_per_prior, -1)
+        # force the bipartite seeds; invalid gt rows scatter out of range
+        # (mode="drop") so they can't race a valid row on the same prior
+        seed_idx = jnp.where(valid_gt, best_prior_per_gt, M)
+        matched_gt = matched_gt.at[seed_idx].set(jnp.arange(G), mode="drop")
+        is_pos = matched_gt >= 0                            # [M]
+
+        safe_gt = jnp.clip(matched_gt, 0, G - 1)
+        mb = bx[safe_gt]                                    # [M, 4]
+        # encode matched gt against priors (center-size, reference box_coder)
+        gw = mb[:, 2] - mb[:, 0]
+        gh = mb[:, 3] - mb[:, 1]
+        gcx = mb[:, 0] + gw / 2
+        gcy = mb[:, 1] + gh / 2
+        tx = (gcx - pcx) / pw / pvar[:, 0]
+        ty = (gcy - pcy) / ph / pvar[:, 1]
+        tw = jnp.log(jnp.maximum(gw / pw, 1e-8)) / pvar[:, 2]
+        th = jnp.log(jnp.maximum(gh / ph, 1e-8)) / pvar[:, 3]
+        target_loc = jnp.stack([tx, ty, tw, th], axis=1)
+
+        # smooth-l1 localization loss over positives
+        d = lc - target_loc
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(axis=1)
+        loc_loss = jnp.where(is_pos, sl1, 0.0).sum()
+
+        # per-prior softmax xent with matched labels (bg where unmatched)
+        tgt_cls = jnp.where(is_pos, lbl[safe_gt].astype(jnp.int32), bg)
+        logp = jax.nn.log_softmax(cf, axis=-1)
+        xent = -jnp.take_along_axis(logp, tgt_cls[:, None], axis=1)[:, 0]
+
+        # max-negative hard mining: top (ratio * n_pos) negatives by loss,
+        # drawn only from priors below neg_overlap (the reference's ignore
+        # band: overlap in [neg_overlap, overlap_threshold) trains neither
+        # way)
+        n_pos = is_pos.sum()
+        n_neg = jnp.minimum((neg_ratio * n_pos).astype(jnp.int32),
+                            M - n_pos)
+        neg_candidate = (~is_pos) & (best_iou_per_prior < neg_overlap)
+        neg_loss = jnp.where(neg_candidate, xent, -jnp.inf)
+        order = jnp.argsort(-neg_loss)
+        rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M))
+        is_neg = neg_candidate & (rank < n_neg)
+
+        conf_loss = jnp.where(is_pos | is_neg, xent, 0.0).sum()
+        total = conf_w * conf_loss + loc_w * loc_loss
+        if not normalize:
+            return total
+        return total / jnp.maximum(n_pos.astype(cf.dtype), 1.0)
+
+    losses = jax.vmap(per_image)(gt_box, gt_label, gt_count, loc, conf)
+    return {"Loss": losses[:, None].astype(conf.dtype)}

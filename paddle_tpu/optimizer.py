@@ -778,13 +778,31 @@ class RecomputeOptimizer:
 
         # split the forward op list into segments ending at checkpoint defs
         segments, cur = [], []
+        matched_any = False
         for op in block.ops:
             cur.append(op)
             if any(n in ck_set for n in op.output_names):
+                matched_any = True
                 segments.append(cur)
                 cur = []
         if cur:
             segments.append(cur)  # tail (loss head) stays inline if short
+        if not matched_any:
+            raise ValueError(
+                "RecomputeOptimizer: no checkpoint variable matched any op "
+                "output in this program — the checkpoints likely came from a "
+                "different program build (transformer.last_layer_outputs "
+                "holds the MOST RECENT build's vars)")
+        # suffix read sets in ONE reverse pass (O(total ops), not
+        # O(segments x ops)): reads_after[si] = names read in segments > si
+        reads_after = [set() for _ in segments]
+        acc: set = set()
+        for si in range(len(segments) - 1, -1, -1):
+            reads_after[si] = set(acc)
+            for op in segments[si]:
+                for n in op.input_names:
+                    if n:
+                        acc.add(n)
 
         new_ops = []
         for si, seg in enumerate(segments[:-1]):
@@ -813,9 +831,7 @@ class RecomputeOptimizer:
                 for n in op.output_names:
                     if n:
                         defined[n] = True
-            later_reads = {
-                n for later in segments[si + 1:] for op in later
-                for n in op.input_names if n}
+            later_reads = reads_after[si]
 
             def _persistable(n):
                 try:
@@ -846,12 +862,6 @@ class RecomputeOptimizer:
             )
             new_ops.append(rec)
         new_ops.extend(segments[-1])
-        if not any(op.type == "recompute" for op in new_ops):
-            raise ValueError(
-                "RecomputeOptimizer: no checkpoint variable matched any op "
-                "output in this program — the checkpoints likely came from a "
-                "different program build (transformer.last_layer_outputs "
-                "holds the MOST RECENT build's vars)")
         block.ops[:] = new_ops
         program._recompute_done = True
         program._bump_version()

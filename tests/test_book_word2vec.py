@@ -1,0 +1,75 @@
+"""Word2vec book test (reference tests/book/test_word2vec.py): n-gram model
+over imikolov data — full-softmax variant from the model zoo, plus the
+large-vocab NCE and hsigmoid variants the reference builds this model to
+motivate."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.dataset import imikolov
+from paddle_tpu.models import word2vec
+
+
+def _batches(word_idx, n, batch, count):
+    gen = imikolov.train(word_idx, n)()
+    grams = []
+    for g in gen:
+        grams.append(g)
+        if len(grams) >= batch * count:
+            break
+    arr = np.array(grams, np.int64)
+    for i in range(0, len(arr) - batch + 1, batch):
+        chunk = arr[i:i + batch]
+        yield {**{f"w{j}": chunk[:, j:j + 1] for j in range(n - 1)},
+               "next_word": chunk[:, -1:]}
+
+
+def test_word2vec_book_full_softmax():
+    word_idx = imikolov.build_dict()
+    V = len(word_idx)
+    avg_loss, predict, feeds = word2vec.word2vec(dict_size=V, embed_dim=16,
+                                                 hidden_size=64, context=4)
+    pt.optimizer.Adam(0.01).minimize(avg_loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    fixed = list(_batches(word_idx, 5, 64, 20))
+    epoch_means = []
+    for _ in range(2):  # same batches twice: epoch means are comparable
+        losses = [float(exe.run(pt.default_main_program(), feed=f,
+                                fetch_list=[avg_loss])[0])
+                  for f in fixed]
+        epoch_means.append(np.mean(losses))
+    assert np.isfinite(epoch_means[1])
+    assert epoch_means[1] < epoch_means[0], epoch_means
+
+
+def test_word2vec_nce_variant():
+    """The same n-gram tower trained with NCE instead of full softmax —
+    the reference nce/hsigmoid docs' motivating setup."""
+    word_idx = imikolov.build_dict()
+    V = len(word_idx)
+    ctx = 4
+    embeds = []
+    for i in range(ctx):
+        w = L.data(name=f"w{i}", shape=[1], dtype="int64")
+        embeds.append(L.embedding(
+            w, size=[V, 16], param_attr=pt.ParamAttr(name="nce_shared_w")))
+    concat = L.concat([L.reshape(e, [-1, 16]) for e in embeds], axis=1)
+    hidden = L.fc(concat, size=64, act="sigmoid")
+    nw = L.data(name="next_word", shape=[1], dtype="int64")
+    nce_cost = L.mean(L.nce(hidden, nw, num_total_classes=V,
+                            num_neg_samples=16, sampler="log_uniform"))
+    hs_cost = L.mean(L.hsigmoid(hidden, nw, num_classes=V))
+    total = L.elementwise_add(nce_cost, hs_cost)
+    pt.optimizer.Adam(0.01).minimize(total)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    fixed = list(_batches(word_idx, 5, 64, 15))
+    epoch_means = []
+    for _ in range(2):
+        losses = [float(exe.run(pt.default_main_program(), feed=f,
+                                fetch_list=[total])[0])
+                  for f in fixed]
+        epoch_means.append(np.mean(losses))
+    assert np.isfinite(epoch_means[1])
+    assert epoch_means[1] < epoch_means[0], epoch_means

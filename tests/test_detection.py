@@ -85,3 +85,44 @@ def test_multiclass_nms_suppresses_overlaps():
     assert kept.sum() == 2, got[0]
     kept_scores = sorted(got[0, kept, 1].tolist(), reverse=True)
     np.testing.assert_allclose(kept_scores, [0.9, 0.7], rtol=1e-5)
+
+
+def test_ssd_loss_trains_toy_detector():
+    """SSD loss end to end: a linear head over fixed priors learns to
+    classify/locate a synthetic box (book SSD pattern on padded gt)."""
+    M, C, G = 8, 3, 2
+    priors = np.stack(np.meshgrid(np.linspace(0.1, 0.7, 4),
+                                  [0.2, 0.6]), -1).reshape(-1, 2)
+    priors = np.concatenate([priors, priors + 0.25], 1).astype(np.float32)
+
+    feat = L.data(name="feat", shape=[16], dtype="float32")
+    loc = L.reshape(L.fc(feat, size=M * 4, name="loc"), [-1, M, 4])
+    conf = L.reshape(L.fc(feat, size=M * C, name="conf"), [-1, M, C])
+    pb = L.data(name="pb", shape=[4], dtype="float32")
+    pb.shape = (M, 4)
+    gtb = L.data(name="gtb", shape=[G, 4], dtype="float32")
+    gtl = L.data(name="gtl", shape=[G, 1], dtype="int64")
+    gtc = L.data(name="gtc", shape=[], dtype="int64")
+    loss = L.mean(L.ssd_loss(loc, conf, gtb, gtl, pb, gt_count=gtc))
+    pt.optimizer.Adam(0.01).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    first = last = None
+    for i in range(40):
+        B = 8
+        featv = rng.standard_normal((B, 16)).astype(np.float32)
+        # gt box near the first prior, one valid gt per image
+        gt = np.tile(priors[0], (B, G, 1)).astype(np.float32)
+        gt += rng.uniform(-0.02, 0.02, gt.shape).astype(np.float32)
+        lbl = np.full((B, G, 1), 1, np.int64)
+        cnt = np.full((B,), 1, np.int64)
+        (lv,) = exe.run(pt.default_main_program(),
+                        feed={"feat": featv, "pb": priors, "gtb": gt,
+                              "gtl": lbl, "gtc": cnt},
+                        fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert np.isfinite(last) and last < first * 0.8, (first, last)
