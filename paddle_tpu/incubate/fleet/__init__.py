@@ -7,3 +7,4 @@ from .base import (  # noqa: F401
     UserDefinedRoleMaker,
     fleet,
 )
+from . import parameter_server  # noqa: F401

@@ -1,0 +1,140 @@
+"""Fleet parameter-server mode.
+
+TPU-native re-design of the reference's transpiler-based fleet
+(/root/reference/python/paddle/fluid/incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py: DistributedTranspiler fleet,
+TranspilerOptimizer): same lifecycle —
+
+    fleet.init(role_maker)
+    optimizer = fleet.distributed_optimizer(inner, strategy)
+    optimizer.minimize(loss)
+    # servers:  fleet.init_server(); fleet.run_server()
+    # trainers: fleet.init_worker(); train(fleet.main_program); fleet.stop_worker()
+
+— riding this repo's DistributeTranspiler + host TCP variable service
+(distributed/ps_rpc.py) instead of gRPC/BRPC: dense math stays on the chip,
+parameter slices and sparse SelectedRows grads travel over DCN.
+"""
+from __future__ import annotations
+
+from .base import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker
+
+__all__ = ["fleet", "ParameterServerFleet", "TranspilerOptimizer"]
+
+
+class ParameterServerFleet:
+    """reference fleet_base.py:38 facade, pserver flavor."""
+
+    def __init__(self):
+        self._role_maker: RoleMakerBase | None = None
+        self._transpiler = None
+        self._origin_main = None
+        self._origin_startup = None
+
+    def init(self, role_maker=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+
+    # -- role views ----------------------------------------------------------
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    @property
+    def server_endpoints(self):
+        return self._role_maker.get_pserver_endpoints()
+
+    # -- programs ------------------------------------------------------------
+    @property
+    def main_program(self):
+        """The transpiled trainer program (reference fleet.main_program)."""
+        if self._transpiler is None:
+            raise RuntimeError("call distributed_optimizer(...).minimize first")
+        return self._transpiler.get_trainer_program()
+
+    @property
+    def startup_program(self):
+        return self._origin_startup
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return TranspilerOptimizer(self, optimizer, strategy)
+
+    # -- server lifecycle ----------------------------------------------------
+    def init_server(self, *args, **kwargs):
+        """Initialize this server's parameter slices (reference
+        init_server runs the pserver startup program)."""
+        from ...executor import Executor
+
+        exe = Executor()
+        exe.run(self._transpiler.get_startup_program())
+
+    def run_server(self):
+        """Blocks serving send/get/barrier until every trainer completes
+        (reference run_server -> listen_and_serv)."""
+        from ...executor import Executor
+
+        ep = self._current_endpoint()
+        exe = Executor()
+        exe.run(self._transpiler.get_pserver_program(ep))
+
+    def _current_endpoint(self):
+        eps = self.server_endpoints
+        return eps[self._role_maker.server_index()]
+
+    # -- worker lifecycle ----------------------------------------------------
+    def init_worker(self):
+        pass  # connections are lazy (PSClient.get on first send/recv)
+
+    def stop_worker(self):
+        from ...executor import Executor
+
+        Executor().close()  # send_complete to every pserver
+
+
+class TranspilerOptimizer:
+    """reference parameter_server TranspilerOptimizer: minimize() then
+    DistributeTranspiler rewrite against the fleet's role layout."""
+
+    def __init__(self, fleet_obj: ParameterServerFleet, inner, strategy=None):
+        self._fleet = fleet_obj
+        self._inner = inner
+        self._config = strategy  # DistributeTranspilerConfig or None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ...framework import default_startup_program
+        from ...transpiler import DistributeTranspiler
+
+        ops, pgs = self._inner.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+        f = self._fleet
+        f._origin_main = loss.block.program
+        f._origin_startup = startup_program or default_startup_program()
+        t = DistributeTranspiler(config=self._config)
+        t.transpile(
+            trainer_id=max(f.worker_index(), 0),
+            program=f._origin_main,
+            pservers=",".join(f.server_endpoints),
+            trainers=f.worker_num(),
+            sync_mode=True,
+            startup_program=f._origin_startup,
+        )
+        f._transpiler = t
+        return ops, pgs
+
+
+fleet = ParameterServerFleet()

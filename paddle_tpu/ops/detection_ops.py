@@ -116,6 +116,25 @@ def box_coder(ctx: ExecContext):
         axis=-1)}
 
 
+def _encode_center_size(prior, pvar, boxes, eps=0.0):
+    """Shared center-size encode (the box_coder formula; ssd_loss target
+    encoding must stay in lockstep with it). prior/pvar [M, 4],
+    boxes [M, 4] matched per prior -> offsets [M, 4]."""
+    pw = prior[:, 2] - prior[:, 0] + eps
+    ph = prior[:, 3] - prior[:, 1] + eps
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    gw = boxes[:, 2] - boxes[:, 0] + eps
+    gh = boxes[:, 3] - boxes[:, 1] + eps
+    gcx = boxes[:, 0] + gw / 2
+    gcy = boxes[:, 1] + gh / 2
+    tx = (gcx - pcx) / pw / pvar[:, 0]
+    ty = (gcy - pcy) / ph / pvar[:, 1]
+    tw = jnp.log(jnp.maximum(gw / pw, 1e-8)) / pvar[:, 2]
+    th = jnp.log(jnp.maximum(gh / ph, 1e-8)) / pvar[:, 3]
+    return jnp.stack([tx, ty, tw, th], axis=1)
+
+
 def _iou(a, b, eps=0.0):
     """Pairwise IoU: a [N, 4], b [M, 4] -> [N, M]. eps=1.0 applies the
     reference's +1 width/height convention for UNnormalized pixel boxes
@@ -250,11 +269,6 @@ def ssd_loss(ctx: ExecContext):
     if pvar is None:
         pvar = jnp.ones_like(prior)
 
-    pw = prior[:, 2] - prior[:, 0]
-    ph = prior[:, 3] - prior[:, 1]
-    pcx = prior[:, 0] + pw / 2
-    pcy = prior[:, 1] + ph / 2
-
     def per_image(bx, lbl, cnt, lc, cf):
         valid_gt = jnp.arange(G) < cnt                      # [G]
         iou = _iou(bx, prior)                               # [G, M]
@@ -274,16 +288,7 @@ def ssd_loss(ctx: ExecContext):
 
         safe_gt = jnp.clip(matched_gt, 0, G - 1)
         mb = bx[safe_gt]                                    # [M, 4]
-        # encode matched gt against priors (center-size, reference box_coder)
-        gw = mb[:, 2] - mb[:, 0]
-        gh = mb[:, 3] - mb[:, 1]
-        gcx = mb[:, 0] + gw / 2
-        gcy = mb[:, 1] + gh / 2
-        tx = (gcx - pcx) / pw / pvar[:, 0]
-        ty = (gcy - pcy) / ph / pvar[:, 1]
-        tw = jnp.log(jnp.maximum(gw / pw, 1e-8)) / pvar[:, 2]
-        th = jnp.log(jnp.maximum(gh / ph, 1e-8)) / pvar[:, 3]
-        target_loc = jnp.stack([tx, ty, tw, th], axis=1)
+        target_loc = _encode_center_size(prior, pvar, mb)
 
         # smooth-l1 localization loss over positives
         d = lc - target_loc

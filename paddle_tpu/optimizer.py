@@ -862,6 +862,11 @@ class RecomputeOptimizer:
             )
             new_ops.append(rec)
         new_ops.extend(segments[-1])
+        if not any(op.type == "recompute" for op in new_ops):
+            raise ValueError(
+                "RecomputeOptimizer: checkpoints matched but produced no "
+                "recompute segment — each non-tail segment needs >= 2 ops "
+                "(is the checkpoint the program's last op, e.g. the loss?)")
         block.ops[:] = new_ops
         program._recompute_done = True
         program._bump_version()

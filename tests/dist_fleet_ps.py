@@ -1,0 +1,84 @@
+"""Fleet parameter-server-mode runner (reference fleet pserver lifecycle over
+the TestDistBase subprocess pattern).
+
+usage: dist_fleet_ps.py ROLE EPS TRAINER_ID N_TRAINERS OUT_NPZ [SERVER_ID]
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+from paddle_tpu.incubate.fleet.parameter_server import fleet  # noqa: E402
+from paddle_tpu.incubate.fleet.base import PaddleCloudRoleMaker  # noqa: E402
+
+STEPS = 5
+FULL_BATCH = 32
+
+
+def build():
+    x = L.data(name="x", shape=[16], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    h = L.fc(x, size=512, act="relu")
+    pred = L.fc(h, size=1)
+    return L.mean(L.square_error_cost(pred, y))
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((FULL_BATCH, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 1)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def main():
+    role, eps, tid, n, out = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                              int(sys.argv[4]), sys.argv[5])
+    sid = sys.argv[6] if len(sys.argv) > 6 else "0"
+    os.environ["TRAINING_ROLE"] = "PSERVER" if role == "pserver" else "TRAINER"
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = eps
+    os.environ["PADDLE_PSERVER_ID"] = sid
+    os.environ["PADDLE_TRAINER_ID"] = str(tid)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(n)
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            loss = build()
+            fleet.init(PaddleCloudRoleMaker())
+            opt = fleet.distributed_optimizer(pt.optimizer.SGD(0.1))
+            opt.minimize(loss)
+
+    if fleet.is_server():
+        with pt.program_guard(main_p, startup):
+            fleet.init_server()
+            fleet.run_server()
+        return
+
+    exe = pt.Executor()
+    with pt.program_guard(main_p, startup):
+        exe.run(startup)
+        fleet.init_worker()
+        x, y = full_data()
+        shard = FULL_BATCH // n
+        lo = tid * shard
+        prog = fleet.main_program
+        for _ in range(STEPS):
+            (lv,) = exe.run(prog, feed={"x": x[lo:lo + shard],
+                                        "y": y[lo:lo + shard]},
+                            fetch_list=[loss.name])
+        fleet.stop_worker()
+    vals = {p.name: np.asarray(pt.global_scope().find_var(p.name))
+            for p in main_p.all_parameters()}
+    vals["__last_loss__"] = np.asarray(lv)
+    np.savez(out, **vals)
+
+
+if __name__ == "__main__":
+    main()

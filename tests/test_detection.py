@@ -36,10 +36,10 @@ def test_box_coder_encode_decode_roundtrip():
     priors = np.array([[0.1, 0.1, 0.4, 0.5], [0.3, 0.2, 0.9, 0.8]],
                       np.float32)
     gts = np.array([[0.15, 0.12, 0.45, 0.47]], np.float32)
-    pb = L.data(name="pb", shape=[4], dtype="float32")
-    pb.shape = (2, 4)
-    gt = L.data(name="gt", shape=[4], dtype="float32")
-    gt.shape = (1, 4)
+    pb = L.data(name="pb", shape=[2, 4], dtype="float32",
+                append_batch_size=False)
+    gt = L.data(name="gt", shape=[1, 4], dtype="float32",
+                append_batch_size=False)
     enc = L.box_coder(pb, None, gt, code_type="encode_center_size")
     dec = L.box_coder(pb, None, enc, code_type="decode_center_size")
     exe = pt.Executor()
@@ -54,10 +54,10 @@ def test_box_coder_encode_decode_roundtrip():
 def test_iou_similarity_values():
     a = np.array([[0, 0, 2, 2]], np.float32)
     b = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [5, 5, 6, 6]], np.float32)
-    x = L.data(name="x", shape=[4], dtype="float32")
-    x.shape = (1, 4)
-    y = L.data(name="y", shape=[4], dtype="float32")
-    y.shape = (3, 4)
+    x = L.data(name="x", shape=[1, 4], dtype="float32",
+               append_batch_size=False)
+    y = L.data(name="y", shape=[3, 4], dtype="float32",
+               append_batch_size=False)
     out = L.iou_similarity(x, y)
     exe = pt.Executor()
     (got,) = exe.run(pt.default_main_program(), feed={"x": a, "y": b},
@@ -98,8 +98,8 @@ def test_ssd_loss_trains_toy_detector():
     feat = L.data(name="feat", shape=[16], dtype="float32")
     loc = L.reshape(L.fc(feat, size=M * 4, name="loc"), [-1, M, 4])
     conf = L.reshape(L.fc(feat, size=M * C, name="conf"), [-1, M, C])
-    pb = L.data(name="pb", shape=[4], dtype="float32")
-    pb.shape = (M, 4)
+    pb = L.data(name="pb", shape=[M, 4], dtype="float32",
+                append_batch_size=False)
     gtb = L.data(name="gtb", shape=[G, 4], dtype="float32")
     gtl = L.data(name="gtl", shape=[G, 1], dtype="int64")
     gtc = L.data(name="gtc", shape=[], dtype="int64")

@@ -77,3 +77,50 @@ def test_pserver_cluster_matches_local(tmp_path):
             tr0[k], tr1[k], rtol=1e-6, atol=1e-7,
             err_msg=f"trainers disagree on param {k}")
     assert float(local["__last_loss__"]) < 10.0
+
+
+def test_fleet_pserver_mode_matches_local(tmp_path):
+    """The fleet pserver lifecycle (init/distributed_optimizer/init_server/
+    run_server/init_worker/stop_worker) reproduces the plain-transpiler
+    cluster result (which itself matches local training, asserted above)."""
+    script = os.path.join(_DIR, "dist_fleet_ps.py")
+    eps = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    ep_list = eps.split(",")
+
+    local_out = str(tmp_path / "local.npz")
+    p = subprocess.Popen(
+        [sys.executable, _SCRIPT, "local", eps, "0", "2", local_out],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out, _ = p.communicate(timeout=240)
+    assert p.returncode == 0, out.decode()[-2000:]
+
+    def spawn(args):
+        return subprocess.Popen(
+            [sys.executable, script, *args], env=_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    pservers = [spawn(["pserver", eps, "0", "2",
+                       str(tmp_path / f"ps{i}.npz"), str(i)])
+                for i in range(len(ep_list))]
+    trainers = [spawn(["trainer", eps, str(i), "2",
+                       str(tmp_path / f"tr{i}.npz")]) for i in range(2)]
+    try:
+        for i, t in enumerate(trainers):
+            out, _ = t.communicate(timeout=240)
+            assert t.returncode == 0, f"trainer {i}: {out.decode()[-3000:]}"
+        for i, ps in enumerate(pservers):
+            out, _ = ps.communicate(timeout=60)
+            assert ps.returncode == 0, f"pserver {i}: {out.decode()[-3000:]}"
+    finally:
+        for pr in trainers + pservers:
+            if pr.poll() is None:
+                pr.kill()
+
+    local = np.load(local_out)
+    tr0 = np.load(str(tmp_path / "tr0.npz"))
+    for k in local.files:
+        if k == "__last_loss__":
+            continue
+        np.testing.assert_allclose(
+            local[k], tr0[k], rtol=1e-4, atol=1e-5,
+            err_msg=f"fleet-ps param {k} diverged from local")
