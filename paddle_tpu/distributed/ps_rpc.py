@@ -97,6 +97,14 @@ class PSClient:
     def fetch_barrier(self) -> None:
         pass  # subsumed: send_barrier only returns post-update
 
+    def checkpoint_notify(self, dirname: str) -> None:
+        """Ask every pserver to persist its parameter slices (reference
+        checkpoint_notify_op.cc / RPCClient::AsyncCheckpointNotify): the
+        server-side save means no slice ever travels back to the trainer."""
+        for ep in self.endpoints:
+            self._call(ep, {"op": "checkpoint", "dirname": dirname,
+                            "trainer": self.trainer_id})
+
     def send_complete(self) -> None:
         for ep in self.endpoints:
             try:
@@ -224,6 +232,29 @@ class PServerRuntime:
         with scope_guard(self.scope):
             self.exe.run(spec["optimize_program"], feed={grad_name: grad})
 
+    def _handle_checkpoint(self, msg):
+        """Persist this server's slices (reference checkpoint_notify -> the
+        pserver-side save in listen_and_serv). One npz per server endpoint;
+        the load side is fleet.init_server(model_dir) (parameter_server.py).
+        Written tmp-then-rename under the lock: concurrent notifies from
+        several trainers must not interleave zip writes."""
+        import os
+
+        dirname = msg["dirname"]
+        os.makedirs(dirname, exist_ok=True)
+        safe_ep = self.endpoint.replace(":", "_").replace("/", "_")
+        path = os.path.join(dirname, f"pserver-{safe_ep}.npz")
+        with self._lock:
+            arrays = {n: np.asarray(self.scope.find_var(n))
+                      for n in self.scope.var_names()
+                      if self.scope.find_var(n) is not None}
+            # np.savez appends ".npz" when missing — keep the suffix so the
+            # tmp name is exactly what gets written
+            tmp = path + f".tmp{msg.get('trainer', 0)}.npz"
+            np.savez(tmp, **arrays)
+            os.replace(tmp, path)
+        return path
+
     def _handle_get(self, msg):
         with self._lock:
             v = self.scope.find_var(msg["name"])
@@ -284,6 +315,8 @@ class PServerRuntime:
                     r = self._handle_barrier(msg, conn)
                     if r == "wait":
                         pass  # reply comes when the round completes
+                elif op == "checkpoint":
+                    conn.send(("ok", self._handle_checkpoint(msg)))
                 elif op == "complete":
                     with self._lock:
                         self._completed.add(msg["trainer"])

@@ -74,13 +74,29 @@ class ParameterServerFleet:
         return TranspilerOptimizer(self, optimizer, strategy)
 
     # -- server lifecycle ----------------------------------------------------
-    def init_server(self, *args, **kwargs):
-        """Initialize this server's parameter slices (reference
-        init_server runs the pserver startup program)."""
-        from ...executor import Executor
+    def init_server(self, model_dir: str | None = None, **kwargs):
+        """Initialize this server's parameter slices; with model_dir, resume
+        from the pserver-<endpoint>.npz written by save_persistables'
+        checkpoint_notify (reference init_server(model_dir) load path)."""
+        import os
+
+        import numpy as np
+
+        from ...executor import Executor, global_scope
 
         exe = Executor()
         exe.run(self._transpiler.get_startup_program())
+        if model_dir:
+            safe_ep = self._current_endpoint().replace(":", "_").replace(
+                "/", "_")
+            path = os.path.join(model_dir, f"pserver-{safe_ep}.npz")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"init_server: no checkpoint for this endpoint at {path}")
+            scope = global_scope()
+            data = np.load(path)
+            for n in data.files:
+                scope.set_var(n, data[n])
 
     def run_server(self):
         """Blocks serving send/get/barrier until every trainer completes
@@ -94,6 +110,19 @@ class ParameterServerFleet:
     def _current_endpoint(self):
         eps = self.server_endpoints
         return eps[self._role_maker.server_index()]
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        """Trainer-side persistables locally + checkpoint_notify so every
+        pserver saves ITS parameter slices in place (reference fleet
+        save_persistables + checkpoint_notify — slices never travel)."""
+        from ... import io
+        from ...distributed.ps_rpc import PSClient
+
+        io.save_persistables(executor, dirname,
+                             main_program or self._origin_main)
+        client = PSClient.get(tuple(self.server_endpoints),
+                              self.worker_index())
+        client.checkpoint_notify(dirname)
 
     # -- worker lifecycle ----------------------------------------------------
     def init_worker(self):
