@@ -680,7 +680,7 @@ class Executor:
             # fallback would reduce over the wrong group); unregistered rings
             # default to the mesh's first (data) axis
             axis_env = {}
-            for ring in range(8):
+            for ring in sorted(set(range(8)) | set(ctx.registered_rings())):
                 if ring in ctx.registered_rings():
                     ax = ctx.axis_of(ring)
                     if ax not in mesh.axis_names:
@@ -700,9 +700,17 @@ class Executor:
 
             def _feed_spec(n):
                 try:
-                    rank = len(block.var(n).shape)
+                    var = block.var(n)
                 except KeyError:
-                    rank = 1
+                    return P(data_axis)
+                # per-var annotations (annotate_sharding) win: sequence-
+                # parallel feeds shard the SEQ dim, not the batch dim.
+                # strict: an unknown axis must not silently replicate
+                if getattr(var, "sharding", None) is not None:
+                    from .parallel.sharding import annotation_spec
+
+                    return annotation_spec(mesh, var, strict=True)
+                rank = len(var.shape)
                 if rank == 0:
                     return P()
                 return P(*([data_axis] + [None] * (rank - 1)))

@@ -206,7 +206,16 @@ def _make_vjp_grad_compute(fwd: OpDef, remat: bool = False):
                 if c is not None:
                     fake_inputs.setdefault(s, {})[i] = c
 
+            # the replay must see the mesh-axis binding: without it a
+            # collective fwd (ring_attention, c_allreduce inside a replayed
+            # segment) silently lowers to LOCAL compute in the backward —
+            # wrong grads with no error (the round-1 advisor's bug class,
+            # which also applies to this shim)
+            from .collective_ops import AXIS_ENV_KEY
+
             env = {}
+            if AXIS_ENV_KEY in ctx.env:
+                env[AXIS_ENV_KEY] = ctx.env[AXIS_ENV_KEY]
 
             class _Shim:
                 inputs = {

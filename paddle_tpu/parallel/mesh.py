@@ -62,6 +62,9 @@ class CommContext:
     def registered_rings(self):
         return self._rings.keys()
 
+    def unregister_ring(self, ring_id: int):
+        self._rings.pop(ring_id, None)
+
     def axis_of(self, ring_id: int) -> str:
         return self._rings.get(ring_id, DATA_AXIS)
 

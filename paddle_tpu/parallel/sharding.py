@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS
 
-__all__ = ["build_shardings", "var_sharding", "annotate_sharding"]
+__all__ = ["build_shardings", "var_sharding", "annotate_sharding", "annotation_spec"]
 
 
 def annotate_sharding(var, spec: tuple):
@@ -31,13 +31,31 @@ def annotate_sharding(var, spec: tuple):
     return var
 
 
+def annotation_spec(mesh: Mesh, var, strict: bool = False) -> P:
+    """Normalize a Variable's sharding annotation to a PartitionSpec.
+
+    strict=False (GSPMD regime): axes missing from the mesh are dropped —
+    the partitioner still produces CORRECT results, just unsharded (running
+    a tp-annotated model on a dp-only mesh is a designed fallback).
+    strict=True (shard_map regime): a missing axis is an ERROR — shard_map
+    in_specs change the VALUES each device sees, so silently replicating a
+    seq-sharded feed computes the wrong thing.
+    """
+    if strict:
+        missing = [a for a in var.sharding
+                   if a is not None and a not in mesh.axis_names]
+        if missing:
+            raise ValueError(
+                f"feed '{var.name}' is annotated with mesh axes {missing} "
+                f"that this mesh {mesh.axis_names} does not have")
+    axes = [a if a in mesh.axis_names else None for a in var.sharding]
+    rank = len(var.shape)
+    return P(*(list(axes) + [None] * rank)[:rank])
+
+
 def var_sharding(mesh: Mesh, var, is_feed: bool) -> NamedSharding:
     if var is not None and var.sharding is not None:
-        axes = [a if a in mesh.axis_names else None for a in var.sharding]
-        # pad to rank
-        rank = len(var.shape)
-        axes = (list(axes) + [None] * rank)[:rank]
-        return NamedSharding(mesh, P(*axes))
+        return NamedSharding(mesh, annotation_spec(mesh, var))
     if is_feed and var is not None and len(var.shape) >= 1 and DATA_AXIS in mesh.axis_names:
         spec = [DATA_AXIS] + [None] * (len(var.shape) - 1)
         return NamedSharding(mesh, P(*spec))

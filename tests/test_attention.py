@@ -167,3 +167,77 @@ def test_transformer_uses_fused_attention():
         transformer.bert_pretrain(cfg, seq_len=16)
     types = [op.type for op in main.global_block.ops]
     assert "fused_attention" in types
+
+
+def test_ring_attention_program_trains_under_collective():
+    """The full program path VERDICT asked for: L.ring_attention inside an
+    executor program, append_backward through the ring op, executed under
+    with_collective on an sp mesh — parameter trajectory matches the same
+    program run single-device (where the ring op is plain attention)."""
+    from paddle_tpu.incubate.fleet import UserDefinedRoleMaker, fleet
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.mesh import get_comm_context
+    from paddle_tpu.parallel.sharding import annotate_sharding
+
+    B, nh, S, dh = 2, 2, 16, 4
+    rng = np.random.default_rng(0)
+    qkv_in = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
+    tgt = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
+
+    def build(sp):
+        x = L.data(name="x", shape=[nh, S, dh], dtype="float32")
+        t = L.data(name="t", shape=[nh, S, dh], dtype="float32")
+        if sp:
+            # sequence-parallel feeds: dim 2 (seq) shards over the sp axis
+            annotate_sharding(x, (None, None, "sp", None))
+            annotate_sharding(t, (None, None, "sp", None))
+        q = L.fc(x, size=dh, num_flatten_dims=3, name="q")
+        out = L.ring_attention(q, x, x, sm_scale=dh ** -0.5, ring_id=5)
+        loss = L.mean(L.square_error_cost(out, t))
+        return loss
+
+    def run_single():
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 9
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                loss = build(sp=False)
+                pt.optimizer.SGD(0.1).minimize(loss)
+        exe = pt.Executor()
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(4):
+                exe.run(main, feed={"x": qkv_in, "t": tgt},
+                        fetch_list=[loss.name])
+            return np.asarray(scope.find_var("q.w_0"))
+
+    def run_ring():
+        mesh = make_mesh({"sp": 8})
+        get_comm_context().register_ring(5, "sp")
+        try:
+            main, startup = pt.Program(), pt.Program()
+            main.random_seed = startup.random_seed = 9
+            with pt.program_guard(main, startup):
+                with pt.unique_name.guard():
+                    loss = build(sp=True)
+                    # fleet grad-allreduce: local (per-seq-shard) grads
+                    # average over sp, reproducing the full-sequence grad
+                    fleet.init(UserDefinedRoleMaker(worker_num=8), mesh=mesh)
+                    opt = fleet.distributed_optimizer(pt.optimizer.SGD(0.1))
+                    opt.minimize(loss)
+            exe = pt.Executor()
+            scope = pt.Scope()
+            with pt.scope_guard(scope):
+                exe.run(startup)
+                compiled = pt.CompiledProgram(main).with_collective(mesh=mesh)
+                for _ in range(4):
+                    exe.run(compiled, feed={"x": qkv_in, "t": tgt},
+                            fetch_list=[loss.name])
+                return np.asarray(scope.find_var("q.w_0"))
+        finally:
+            get_comm_context().unregister_ring(5)
+
+    base_w = run_single()
+    ring_w = run_ring()
+    np.testing.assert_allclose(base_w, ring_w, rtol=1e-4, atol=1e-5)
