@@ -52,7 +52,10 @@ def _real(split):
 
     labels = loadmat(locate("flowers", "imagelabels.mat"))["labels"][0]
     setid = loadmat(locate("flowers", "setid.mat"))
-    key = {"train": "trnid", "test": "tstid", "valid": "valid"}[split]
+    # The reference deliberately swaps the official splits (flowers.py
+    # TRAIN_FLAG='tstid', TEST_FLAG='trnid'): the official test set is the
+    # large one, so training uses it.
+    key = {"train": "tstid", "test": "trnid", "valid": "valid"}[split]
     wanted = set(int(i) for i in setid[key][0])
     with tarfile.open(locate("flowers", "102flowers.tgz"), "r:gz") as tf:
         for m in tf.getmembers():

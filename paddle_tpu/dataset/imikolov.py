@@ -33,22 +33,27 @@ def is_synthetic() -> bool:
 def build_dict(min_word_freq: int = 50) -> dict:
     path = locate("imikolov", "simple-examples.tgz")
     if path:
+        # reference contract: count ptb.train.txt AND ptb.valid.txt, add one
+        # '<s>'/'<e>' per line, keep words with freq strictly > threshold,
+        # assign ids by (-frequency, word), then append only '<unk>'
         freq: dict = {}
         with tarfile.open(path, "r:gz") as tf:
             for m in tf.getmembers():
-                if m.name.endswith("ptb.train.txt"):
+                if m.name.endswith(("ptb.train.txt", "ptb.valid.txt")):
                     for line in tf.extractfile(m).read().decode(
                             "utf-8").splitlines():
-                        for w in line.split():
+                        for w in line.split() + ["<s>", "<e>"]:
                             freq[w] = freq.get(w, 0) + 1
-        words = [w for w, c in freq.items() if c >= min_word_freq]
-        d = {w: i for i, w in enumerate(sorted(words))}
+        words = sorted(
+            ((w, c) for w, c in freq.items() if c > min_word_freq),
+            key=lambda wc: (-wc[1], wc[0]))
+        d = {w: i for i, (w, _) in enumerate(words)}
+        d["<unk>"] = len(d)
     else:
         d = {f"w{i}": i for i in range(_VOCAB - 3)}
-    # reference build_dict counts the specials into the vocabulary
-    d["<s>"] = len(d)
-    d["<unk>"] = len(d)
-    d["<e>"] = len(d)
+        d["<s>"] = len(d)
+        d["<unk>"] = len(d)
+        d["<e>"] = len(d)
     return d
 
 
@@ -84,8 +89,12 @@ def _reader(split, n, seed, word_idx, ngram_n, data_type):
                     for i in range(ngram_n, len(l) + 1):
                         yield tuple(l[i - ngram_n:i])
             else:
-                # reference SEQ: src = [<s>] + l, trg = l + [<e>]
-                yield [s_] + sent, sent + [e]
+                # reference SEQ: src = [<s>] + l, trg = l + [<e>],
+                # skipping sentences longer than n (when n > 0)
+                src, trg = [s_] + sent, sent + [e]
+                if ngram_n > 0 and len(src) > ngram_n:
+                    continue
+                yield src, trg
 
     return reader
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import secrets
 import signal
 import socket
 import subprocess
@@ -76,10 +77,14 @@ def launch(args) -> int:
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
+    # one random pserver-RPC auth secret per launch, shared by every rank
+    ps_authkey = os.environ.get("PADDLE_PS_AUTHKEY") or secrets.token_hex(16)
+
     procs, logs = [], []
     for rank in range(n):
         env = dict(os.environ)
         env.update({
+            "PADDLE_PS_AUTHKEY": ps_authkey,
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(n),
             "PADDLE_COORDINATOR": coordinator,

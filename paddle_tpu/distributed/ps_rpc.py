@@ -24,7 +24,14 @@ from typing import Any
 
 import numpy as np
 
-_AUTHKEY = b"paddle_tpu_ps"
+def _authkey() -> bytes:
+    """Connection auth secret. The launcher exports PADDLE_PS_AUTHKEY (one
+    random value per launch) so all ranks share it; a hand-run cluster must
+    export it itself. The fallback keeps single-process tests working but is
+    NOT a security boundary."""
+    import os
+
+    return os.environ.get("PADDLE_PS_AUTHKEY", "paddle_tpu_ps").encode()
 
 
 def _parse_ep(ep: str):
@@ -58,7 +65,7 @@ class PSClient:
             deadline = time.monotonic() + 30.0
             while True:
                 try:
-                    self._conns[ep] = Client(_parse_ep(ep), authkey=_AUTHKEY)
+                    self._conns[ep] = Client(_parse_ep(ep), authkey=_authkey())
                     break
                 except (ConnectionRefusedError, FileNotFoundError):
                     if time.monotonic() > deadline:
@@ -277,7 +284,7 @@ class PServerRuntime:
             pass
 
     def serve(self):
-        listener = Listener(_parse_ep(self.endpoint), authkey=_AUTHKEY)
+        listener = Listener(_parse_ep(self.endpoint), authkey=_authkey())
         threads = []
         while not self._shutdown.is_set():
             try:

@@ -127,9 +127,12 @@ class Categorical(Distribution):
             L.elementwise_mul(p, L.elementwise_sub(logp, logq)), dim=-1)
 
     def log_prob(self, value):
-        """value: int64 indices into the last dim."""
+        """value: int64 indices into the last dim; accepts [B], [B,1], or
+        any batched [..., 1]/[...] layout matching logits[..., :-1]."""
         p = self._probs()
-        onehot = L.one_hot(L.unsqueeze(L.cast(value, "int64"), axes=[-1]),
+        # one_hot itself strips a trailing size-1 dim, so [B,1]->[B,V] and
+        # [B]->[B,V] both line up with probs [B,V] (and [B,T] with [B,T,V])
+        onehot = L.one_hot(L.cast(value, "int64"),
                            depth=self.logits.shape[-1])
         return L.log(L.scale(
             L.reduce_sum(L.elementwise_mul(p, onehot), dim=-1), bias=1e-12))

@@ -41,7 +41,8 @@ def _fqdq_grad(ctx: ExecContext):
     return {"X@GRAD": ctx.input("Out@GRAD")}
 
 
-@register_op("fake_quantize_dequantize_moving_average_abs_max")
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             stateful_outputs=("OutScale",))
 def fake_quantize_dequantize_moving_average_abs_max(ctx: ExecContext):
     """Activation quantization with a moving-average scale (reference
     FakeQuantizeMovingAverageAbsMax). InScale carries the running scale."""

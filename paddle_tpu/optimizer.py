@@ -898,7 +898,7 @@ class ModelAverage(Optimizer):
             "increment", {"X": [total.name]}, {"Out": [total.name]},
             {"step": 1.0})
         for p in self._params:
-            s = self._add_accumulator("ma_sum", p)
+            s = self._add_accumulator("ma_sum", p, dtype=p.dtype)
             c = self._add_accumulator("ma_cnt", p, shape=[1])
             default_main_program().global_block.append_op(
                 "model_average_accum",
@@ -978,7 +978,7 @@ class LookaheadOptimizer:
                 continue
             slow = helper.create_or_get_global_variable(
                 unique_name.generate(p.name + "_slow"), list(p.shape),
-                "float32", initializer=None)
+                p.dtype, initializer=None)
             # slow starts equal to fast: copy in the startup program
             default_startup_program().global_block.append_op(
                 "assign", {"X": [p.name]}, {"Out": [slow.name]}, {})

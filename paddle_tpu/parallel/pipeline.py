@@ -303,21 +303,38 @@ class PipelinePlan:
             for m, chunk in enumerate(np.split(val, M)):
                 micro_feeds[m][name] = chunk
 
-        # resolve fetches: the stage whose fwd program defines each name
+        # resolve fetches: prefer the stage whose fwd program PRODUCES the
+        # name (an op output) over one that merely reads it; among producers
+        # take the first, so a later stage re-using a temp name can't shadow
+        # the intended tensor
         fetch_stage: dict[str, int] = {}
         for name in fetch_names:
+            holder = None
             for s, stage in enumerate(self.stages):
-                if stage.fwd.global_block.has_var(name):
+                blk = stage.fwd.global_block
+                if not blk.has_var(name):
+                    continue
+                if holder is None:
+                    holder = s
+                produced = any(
+                    name in names
+                    for op in blk.ops for names in op.outputs.values())
+                if produced:
                     fetch_stage[name] = s
+                    break
             if name not in fetch_stage:
-                raise KeyError(f"fetch '{name}' not found in any pipeline stage")
+                if holder is None:
+                    raise KeyError(
+                        f"fetch '{name}' not found in any pipeline stage")
+                fetch_stage[name] = holder
 
         # --- forward: all microbatches stage-by-stage (GPipe fill) ----------
         stash: list[dict[str, Any]] = [dict() for _ in range(M)]
         fetched: dict[str, list] = {n: [] for n in fetch_names}
         for s, stage in enumerate(self.stages):
             wanted = list(stage.out_names) + [
-                n for n in fetch_names if fetch_stage[n] == s]
+                n for n in fetch_names
+                if fetch_stage[n] == s and n not in stage.out_names]
             for m in range(M):
                 f = {n: micro_feeds[m][n] for n in stage.ext_inputs
                      if n in micro_feeds[m]}

@@ -191,5 +191,7 @@ def test_new_datasets_yield_contract_tuples():
     wd = imikolov.build_dict()
     grams = list(imikolov.train(wd, 5)())[:3]
     assert all(len(g) == 5 for g in grams)
-    src, trg = next(iter(imikolov.train(wd, 5, imikolov.DataType.SEQ)()))
+    # SEQ mode drops sentences longer than n (reference max-len filter),
+    # so use an n above the synthetic max sentence length
+    src, trg = next(iter(imikolov.train(wd, 40, imikolov.DataType.SEQ)()))
     assert trg[:-1] == src[1:]

@@ -1,0 +1,36 @@
+"""Raw JAX conv microbench; axon tunnel: sync via host read, not block_until_ready."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+
+def drain(x):
+    return np.asarray(jax.jit(lambda v: v.reshape(-1)[0])(x))
+
+B = 128
+for dtype in (jnp.bfloat16, jnp.float32):
+    for (ci, co, h, w, k) in [(256, 256, 56, 56, 3), (512, 512, 28, 28, 3)]:
+        x = jnp.full((B, ci, h, w), 0.5, dtype)
+        wt = jnp.full((co, ci, k, k), 0.001, dtype)
+        f = jax.jit(lambda x, wt: jax.lax.conv_general_dilated(
+            x, wt, (1, 1), [(k//2, k//2)]*2,
+            dimension_numbers=("NCHW", "OIHW", "NCHW")) * 0.01)
+        y = f(x, wt); drain(y)
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(20):
+            y = f(y, wt)
+        drain(y)
+        dt = (time.perf_counter() - t0) / 20
+        fl = 2 * B * co * ci * k * k * h * w
+        print(f"{dtype.__name__} conv {ci}->{co} {h}x{w} k{k}: {dt*1e3:.2f} ms, {fl/dt/1e12:.1f} TF/s", flush=True)
+
+a = jnp.full((8192, 4096), 0.5, jnp.bfloat16)
+b = jnp.full((4096, 4096), 0.001, jnp.bfloat16)
+f = jax.jit(lambda a, b: (a @ b))
+drain(f(a, b))
+t0 = time.perf_counter()
+z = a
+for _ in range(20):
+    z = f(z, b)
+drain(z)
+dt = (time.perf_counter() - t0) / 20
+print(f"matmul 8192x4096x4096 bf16: {dt*1e3:.2f} ms, {2*8192*4096*4096/dt/1e12:.1f} TF/s")

@@ -1,0 +1,36 @@
+"""Loop-inside-jit microbench: isolates device compute from tunnel overhead."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from functools import partial
+
+def drain(x):
+    return np.asarray(jax.jit(lambda v: v.reshape(-1)[0])(x))
+
+ITERS = 50
+B = 128
+for (ci, co, h, w, k) in [(256, 256, 56, 56, 3)]:
+    for dtype in (jnp.bfloat16, jnp.float32):
+        x = jnp.full((B, ci, h, w), 0.5, dtype)
+        wt = jnp.full((co, ci, k, k), 0.001, dtype)
+        @jax.jit
+        def f(x, wt):
+            def body(i, v):
+                return jax.lax.conv_general_dilated(
+                    v, wt, (1, 1), [(k//2, k//2)]*2,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW")) * 0.01
+            return jax.lax.fori_loop(0, ITERS, body, x)
+        drain(f(x, wt))
+        t0 = time.perf_counter(); drain(f(x, wt))
+        dt = (time.perf_counter() - t0) / ITERS
+        fl = 2 * B * co * ci * k * k * h * w
+        print(f"{dtype.__name__} conv {ci}->{co} {h}x{w} k{k}: {dt*1e3:.3f} ms/conv, {fl/dt/1e12:.1f} TF/s", flush=True)
+
+a = jnp.full((8192, 4096), 0.5, jnp.bfloat16)
+b = jnp.full((4096, 4096), 0.001, jnp.bfloat16)
+@jax.jit
+def g(a, b):
+    return jax.lax.fori_loop(0, ITERS, lambda i, v: (v @ b) * 0.001, a)
+drain(g(a, b))
+t0 = time.perf_counter(); drain(g(a, b))
+dt = (time.perf_counter() - t0) / ITERS
+print(f"matmul 8192x4096x4096 bf16: {dt*1e3:.3f} ms, {2*8192*4096*4096/dt/1e12:.1f} TF/s")

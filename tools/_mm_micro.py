@@ -1,0 +1,24 @@
+import time
+import jax, jax.numpy as jnp, numpy as np
+
+def drain(x):
+    return np.asarray(jax.jit(lambda v: v.reshape(-1)[0])(x))
+
+a = jnp.full((8192, 4096), 0.5, jnp.bfloat16)
+b = jnp.full((4096, 4096), 0.001, jnp.bfloat16)
+N = 50
+@jax.jit
+def g(a, b):
+    v = a
+    for _ in range(N):
+        v = v @ b
+    return v
+drain(g(a, b))
+t0 = time.perf_counter(); drain(g(a, b))
+dt = (time.perf_counter() - t0) / N
+print(f"unrolled matmul chain: {dt*1e3:.3f} ms/mm, {2*8192*4096*4096/dt/1e12:.1f} TF/s")
+# and the drain latency itself
+t0 = time.perf_counter()
+for _ in range(5):
+    drain(a)
+print(f"drain latency: {(time.perf_counter()-t0)/5*1e3:.1f} ms")

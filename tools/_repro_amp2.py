@@ -1,0 +1,24 @@
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS","") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.models import resnet
+
+main_p, startup = pt.Program(), pt.Program()
+with pt.program_guard(main_p, startup):
+    loss, acc, _ = resnet.resnet_cifar10()
+    opt = pt.contrib.mixed_precision.decorate(pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9))
+    opt.minimize(loss)
+
+blk = main_p.blocks[0]
+target = "res2.2.c2.w_0@BF16"
+for i, op in enumerate(blk.ops):
+    ins = [n for ns in op.inputs.values() for n in ns]
+    outs = [n for ns in op.outputs.values() for n in ns]
+    if target in ins or target in outs:
+        print(i, op.type, "IN:", ins, "OUT:", outs)
+v = blk.vars.get(target)
+print("var dtype:", getattr(v, "dtype", None))

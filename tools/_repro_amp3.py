@@ -1,0 +1,39 @@
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS","") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.models import resnet
+from paddle_tpu.ops import registry
+
+orig = registry._make_vjp_grad_compute
+def patched(fwd):
+    inner = orig(fwd)
+    def wrapper(ctx):
+        if fwd.type == "conv2d":
+            op = ctx.op
+            for s, names in op.inputs.items():
+                for n in names:
+                    v = ctx.env.get(n)
+                    print(f"  GRADIN {s} {n}: {None if v is None else jax.numpy.asarray(v).dtype}")
+        return inner(ctx)
+    return wrapper
+registry._make_vjp_grad_compute = patched
+registry._REGISTRY.pop("conv2d_grad", None)
+
+main_p, startup = pt.Program(), pt.Program()
+with pt.program_guard(main_p, startup):
+    loss, acc, _ = resnet.resnet_cifar10()
+    opt = pt.contrib.mixed_precision.decorate(pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9))
+    opt.minimize(loss)
+
+rng = np.random.default_rng(0)
+feed = {"img": rng.standard_normal((4, 3, 32, 32), dtype=np.float32),
+        "label": rng.integers(0, 10, (4, 1)).astype(np.int64)}
+exe = pt.Executor()
+with pt.scope_guard(pt.Scope()):
+    exe.run(startup)
+    out = exe.run(main_p, feed=feed, fetch_list=[loss])
+    print("OK loss=", float(np.asarray(out[0])))

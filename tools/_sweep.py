@@ -1,0 +1,46 @@
+"""Scratch perf sweep. Usage: python _sweep.py <batch> <seq> <flash:0|1>"""
+import sys, time, json
+import jax, numpy as np
+
+def run(batch, seq_len, flash):
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+    cfg = transformer.TransformerConfig(
+        vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+        ffn_size=3072, max_position=max(512, seq_len), dropout=0.0, use_tp=False,
+        use_flash_attention=bool(flash))
+    iters = 20
+    import os as _os
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        avg_loss, _ = transformer.bert_pretrain(cfg, seq_len=seq_len)
+        opt = pt.contrib.mixed_precision.decorate(pt.optimizer.Adam(learning_rate=1e-4))
+        if _os.environ.get("SWEEP_RECOMPUTE"):
+            opt = pt.optimizer.RecomputeOptimizer(opt)
+            opt._set_checkpoints(list(transformer.last_layer_outputs))
+        opt.minimize(avg_loss)
+    from __graft_entry__ import _example_feed
+    feed = _example_feed(cfg, batch, seq_len)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main_p, feed=feed, fetch_list=[avg_loss])
+        exe.run(main_p, feed=feed)
+        np.asarray(pt.global_scope().find_var("lm_head.b"))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            exe.run(main_p, feed=feed)
+        np.asarray(pt.global_scope().find_var("lm_head.b"))
+        dt = (time.perf_counter() - t0) / iters
+        (loss,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
+        assert np.isfinite(float(np.asarray(loss))), "loss not finite"
+    tokens = batch * seq_len
+    H, L_, F, V = cfg.hidden_size, cfg.num_layers, cfg.ffn_size, cfg.vocab_size
+    n_params = L_ * (4 * H * H + 2 * H * F) + H * V
+    step_flops = 6 * n_params * tokens + 12 * L_ * H * seq_len * tokens
+    mfu = (step_flops / dt) / 197e12
+    print(json.dumps({"batch": batch, "seq": seq_len, "flash": flash,
+                      "tok_s": round(tokens / dt, 1), "mfu": round(mfu, 4)}))
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

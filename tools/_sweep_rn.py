@@ -1,0 +1,39 @@
+"""Scratch ResNet-50 perf sweep behind PERF.md numbers.
+Usage: python tools/_sweep_rn.py <batch>   (SWEEP_AMP=0 for the fp32 variant)"""
+import os, sys, time, json
+import jax, numpy as np
+
+def run(batch):
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+    iters = 20
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        loss, acc, _ = resnet.resnet50()
+        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        if os.environ.get("SWEEP_AMP", "1") != "0":
+            opt = pt.contrib.mixed_precision.decorate(opt)
+        opt.minimize(loss)
+    rng = np.random.default_rng(0)
+    feed = {"img": jax.device_put(rng.standard_normal((batch, 3, 224, 224), dtype=np.float32)),
+            "label": jax.device_put(rng.integers(0, 1000, (batch, 1)).astype(np.int32))}
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        exe.run(main_p, feed=feed)
+        np.asarray(pt.global_scope().find_var("fc_0.b_0"))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            exe.run(main_p, feed=feed)
+        np.asarray(pt.global_scope().find_var("fc_0.b_0"))
+        dt = (time.perf_counter() - t0) / iters
+        (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv)))
+    img_s = batch / dt
+    # ResNet-50 @224: ~4.09 GFLOP fwd/image; train ~ 3x fwd
+    mfu = (3 * 4.089e9 * img_s) / 197e12
+    print(json.dumps({"batch": batch, "img_s": round(img_s, 1), "mfu": round(mfu, 4)}))
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]))
