@@ -17,6 +17,8 @@ white_list = {
     "conv2d",
     "depthwise_conv2d",
     "conv2d_transpose",
+    # MXU carrier with fp32 softmax statistics inside the kernel
+    "fused_attention",
 }
 
 black_list = {

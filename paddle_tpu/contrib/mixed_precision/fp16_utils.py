@@ -63,6 +63,20 @@ def rewrite_program(main_program: Program, amp_lists, dest_dtype="bfloat16"):
     return n_casts
 
 
+def _mixed_float_inputs(block, op) -> bool:
+    """True when the op reads BOTH a low-precision and an fp32 float input —
+    the case where jnp promotion would silently drag the activation back up."""
+    seen = set()
+    for names in op.inputs.values():
+        for n in names:
+            if not n or not block.has_var(n):
+                continue
+            dt = block.var(n).dtype
+            if dt in (DType.FP32, DType.BF16, DType.FP16):
+                seen.add(dt)
+    return DType.FP32 in seen and (DType.BF16 in seen or DType.FP16 in seen)
+
+
 def _rewrite_block(block, amp_lists, dest_dtype):
     from ...ops.registry import infer_op
 
@@ -76,6 +90,13 @@ def _rewrite_block(block, amp_lists, dest_dtype):
             target = dest_dtype
         elif op.type in amp_lists.black_list:
             target = "float32"
+        elif op.type != "cast" and _mixed_float_inputs(block, op):
+            # gray/unlisted op mixing bf16 activations with fp32 side inputs
+            # (bias add, residual add against an fp32 stream, LN gain/bias):
+            # harmonize DOWN. Without this every such op promotes to fp32 and
+            # the whole residual/FFN stream materializes at 2x width — the
+            # single largest HBM cost found in the r2 perf audit (PERF.md).
+            target = dest_dtype
         if target is None:
             # gray op: no casts, but RE-INFER its output dtype so bf16-ness
             # propagates through metadata — otherwise a black op downstream

@@ -1009,11 +1009,15 @@ def lod_reset(x, y=None, target_lod=None):
     return x
 
 
-def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None, name=None):
-    """Fused (flash) scaled-dot-product attention over [B, nh, S, dh] tensors
-    (Pallas kernel on TPU, O(S) memory; see ops/attention_ops.py). The
-    reference builds attention from matmul+softmax ops (nets.py:345) — this
-    is the TPU-native fused equivalent."""
+def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    use_pallas=False, name=None):
+    """Fused scaled-dot-product attention over [B, nh, S, dh] tensors —
+    one op boundary for the whole QK^T -> softmax -> PV block, dispatched by
+    measurement (ops/attention_ops.py): XLA fusion at train sizes, the
+    custom short-seq Pallas kernel with `use_pallas` (O(S) memory), jax's
+    bundled flash kernel for long sequences. The reference builds attention
+    from matmul+softmax ops (nets.py:345) — this is the TPU-native fused
+    equivalent."""
     helper = LayerHelper("fused_attention", name=name)
     if sm_scale is None:
         sm_scale = float(q.shape[-1]) ** -0.5
@@ -1023,7 +1027,8 @@ def fused_attention(q, k, v, bias=None, causal=False, sm_scale=None, name=None):
         inputs["Bias"] = [bias]
     helper.append_op(
         "fused_attention", inputs, {"Out": [out]},
-        {"causal": causal, "sm_scale": float(sm_scale)},
+        {"causal": causal, "sm_scale": float(sm_scale),
+         "use_pallas": bool(use_pallas)},
     )
     return out
 

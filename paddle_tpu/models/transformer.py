@@ -103,11 +103,15 @@ def multi_head_attention(x, cfg: TransformerConfig, attn_bias=None, name="attn")
     k = L.squeeze(L.slice(qkv, axes=[0], starts=[1], ends=[2]), axes=[0])
     v = L.squeeze(L.slice(qkv, axes=[0], starts=[2], ends=[3]), axes=[0])
 
-    use_fused = (cfg.use_flash_attention and attn_bias is None
-                 and not cfg.dropout)
+    # one fused-attention op boundary whenever semantics allow (no additive
+    # bias, no attention-prob dropout): the op dispatches to the measured
+    # winner per shape — XLA fusion at train sizes, Pallas for long context.
+    # cfg.use_flash_attention forces the custom Pallas kernel (O(S) memory).
+    use_fused = attn_bias is None and not cfg.dropout
     if use_fused:
         ctxv = L.fused_attention(q, k, v, causal=cfg.causal,
-                                 sm_scale=dh ** -0.5)  # [B,nh,S,dh]
+                                 sm_scale=dh ** -0.5,
+                                 use_pallas=cfg.use_flash_attention)
     else:
         scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
         if attn_bias is not None:

@@ -2,13 +2,16 @@
 
 The reference has no fused attention — its scaled_dot_product_attention
 (nets.py:345) materializes the full [B,nh,S,S] score matrix through separate
-matmul/softmax/dropout ops. On TPU the fused kernel is the single biggest
-HBM-traffic win for transformers (SURVEY.md §2.3 row "ring attention"), so:
+matmul/softmax/dropout ops. On TPU one fused op boundary for the whole
+QK^T -> softmax -> PV block is the single biggest transformer win
+(SURVEY.md §2.3), so:
 
-  * `fused_attention` lowers to jax's bundled Pallas TPU flash-attention
-    kernel (jax.experimental.pallas.ops.tpu.flash_attention — public JAX
-    code, O(S) memory, fwd+bwd kernels with custom VJP). Off-TPU it falls
-    back to a straightforward jnp reference with identical semantics.
+  * `fused_attention` dispatches per measured winner (PERF.md): at train
+    sizes (S <= 1024) the jnp einsum composition — XLA's attention fusion
+    with fp32 softmax statistics, recompute-in-backward via the derived
+    vjp; with `use_pallas` the hand-tuned short-seq Pallas kernel
+    (ops/pallas_kernels/attention.py, O(S) residuals); at S > 1024 jax's
+    bundled flash-attention kernel (the only O(S)-memory option there).
   * `ring_attention` is the sequence-parallel form: K/V shards rotate around
     the `sp` mesh axis via collective-permute while each device keeps a
     running online-softmax merge (m, l, acc). Pure differentiable jnp +
@@ -30,17 +33,23 @@ _NEG_INF = -1e9
 
 
 def _reference_attention(q, k, v, bias=None, causal=False, sm_scale=1.0):
-    """Plain jnp attention, the numeric oracle (and CPU path).
-    q,k,v: [B, nh, S, dh]."""
+    """Plain jnp attention, the numeric oracle (and the measured-fastest
+    TPU path at train sizes). q,k,v: [B, nh, S, dh]. Softmax statistics are
+    fp32 even for bf16 operands (the AMP white-list invariant); XLA fuses
+    the boundary casts so this costs no extra HBM traffic."""
+    # scores materialize in the operand dtype (bf16 under AMP — half the
+    # HBM bytes); the fp32 upcast happens inside the softmax so the
+    # max/exp/sum statistics are fp32 yet XLA fuses the casts for free
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    scores = scores.astype(jnp.float32)
     if bias is not None:
-        scores = scores + bias
+        scores = scores + bias.astype(scores.dtype)
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), sk - sq)
         scores = jnp.where(mask, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(probs.dtype))
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
 def _on_tpu() -> bool:
@@ -55,12 +64,27 @@ def _block_multiple_ok(s: int) -> bool:
     return s % 128 == 0
 
 
-def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0):
-    """Dispatch: Pallas kernel on TPU for well-shaped inputs, else reference."""
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0,
+                    use_pallas=False):
+    """Dispatch (each branch measured on v5e, PERF.md):
+      * short/medium sequences: the jnp einsum composition — XLA's own
+        attention fusion is the fastest at S<=512 (beats both the bundled
+        flash kernel and the custom short-seq Pallas kernel);
+      * `use_pallas`: the hand-tuned short-seq kernel (O(S) memory with a
+        no-residual fused backward — for memory-bound configs);
+      * long sequences whose [S,S] scores outgrow VMEM/HBM budgets: jax's
+        bundled flash kernel (the only O(S) option there).
+    """
+    from .pallas_kernels import attention as psa
+
     B, nh, sq, dh = q.shape
     sk = k.shape[2]
-    if (_on_tpu() and _block_multiple_ok(sq) and _block_multiple_ok(sk)
-            and q.dtype != jnp.float64):
+    if ((_on_tpu() or psa.INTERPRET) and use_pallas
+            and psa.short_seq_supported(q.shape, k.shape, bias)):
+        return psa.short_seq_attention(q, k, v, causal=causal,
+                                       sm_scale=float(sm_scale))
+    if (_on_tpu() and sq > 1024 and _block_multiple_ok(sq)
+            and _block_multiple_ok(sk) and q.dtype != jnp.float64):
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
         return fa.flash_attention(q, k, v, ab=bias, causal=causal,
@@ -76,7 +100,8 @@ def fused_attention(ctx: ExecContext):
     bias = ctx.input("Bias") if ctx.has_input("Bias") else None
     out = flash_attention(q, k, v, bias,
                           causal=ctx.attr("causal", False),
-                          sm_scale=ctx.attr("sm_scale", 1.0))
+                          sm_scale=ctx.attr("sm_scale", 1.0),
+                          use_pallas=ctx.attr("use_pallas", False))
     return {"Out": out.astype(q.dtype)}
 
 

@@ -27,6 +27,14 @@ def mul(ctx: ExecContext):
     x, y = ctx.input("X"), ctx.input("Y")
     xn = ctx.attr("x_num_col_dims", 1)
     yn = ctx.attr("y_num_col_dims", 1)
+    if x.shape[xn:] == y.shape[:yn]:
+        # rank-preserving contraction: no flatten/unflatten reshapes, so XLA
+        # never has to reconcile [B,S,H] and [B*S,H] tilings with physical
+        # copies (measured as one of the big per-step HBM costs, PERF.md)
+        dims = (tuple(range(xn, x.ndim)), tuple(range(yn)))
+        out = jax.lax.dot_general(x, y, (dims, ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return {"Out": out.astype(x.dtype)}
     x2 = _flatten_2d(x, xn)
     y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
     out = jnp.matmul(x2, y2, preferred_element_type=jnp.float32).astype(x.dtype)

@@ -1,0 +1,10 @@
+"""Hand-written Pallas TPU kernels (the compute-path native layer).
+
+XLA fuses most of the framework's ops well; these kernels exist for the
+cases where measurement (PERF.md) showed XLA leaving throughput on the
+table. Each kernel module exposes a plain jax-callable function with a
+custom VJP so the op registry's derived-gradient machinery works through it.
+"""
+from .attention import short_seq_attention, short_seq_supported
+
+__all__ = ["short_seq_attention", "short_seq_supported"]

@@ -1,0 +1,85 @@
+"""Short-seq fused attention kernel vs the jnp reference (fwd + grads).
+
+Runs the Pallas kernels through the interpreter on the CPU test mesh; the
+same code path compiles on TPU (exercised by bench.py / __graft_entry__).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.attention_ops import _reference_attention
+from paddle_tpu.ops.pallas_kernels import attention as psa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    psa.INTERPRET = True
+    yield
+    psa.INTERPRET = False
+
+
+def _rand(shape, dtype, seed):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fwd_matches_reference(causal, dtype):
+    B, nh, S, dh = 2, 3, 128, 64
+    q, k, v = (_rand((B, nh, S, dh), dtype, i) for i in range(3))
+    sm = dh ** -0.5
+    out = psa.short_seq_attention(q, k, v, causal=causal, sm_scale=sm)
+    ref = _reference_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                               v.astype(jnp.float32), causal=causal,
+                               sm_scale=sm)
+    tol = 2e-2 if dtype == "bfloat16" else 2e-5
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=tol, rtol=tol)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(causal):
+    B, nh, S, dh = 1, 2, 128, 32
+    q, k, v = (_rand((B, nh, S, dh), "float32", 10 + i) for i in range(3))
+    sm = dh ** -0.5
+    ct = _rand((B, nh, S, dh), "float32", 99)
+
+    def via_kernel(q, k, v):
+        return jnp.sum(psa.short_seq_attention(q, k, v, causal=causal,
+                                               sm_scale=sm) * ct)
+
+    def via_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, causal=causal,
+                                            sm_scale=sm) * ct)
+
+    g_kernel = jax.grad(via_kernel, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(via_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_kernel, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-3, err_msg=name)
+
+
+def test_head_block_respects_budget_and_divides():
+    for nh in (1, 2, 3, 12, 16, 24):
+        for s in (128, 256, 512, 1024):
+            gh = psa._head_block(nh, s, 64, 2, 9)
+            assert nh % gh == 0 and gh >= 1
+
+
+def test_supported_gate():
+    ok = ((2, 12, 128, 64), (2, 12, 128, 64))
+    assert psa.short_seq_supported(*ok, bias=None)
+    assert not psa.short_seq_supported(*ok, bias=object())
+    assert not psa.short_seq_supported((2, 12, 130, 64), (2, 12, 130, 64),
+                                       bias=None)
+    assert not psa.short_seq_supported((2, 12, 128, 64), (2, 12, 256, 64),
+                                       bias=None)
+    assert not psa.short_seq_supported((2, 12, 2048, 64), (2, 12, 2048, 64),
+                                       bias=None)
+    # S=1024 bwd intermediates outgrow VMEM at gh=1 — must be rejected
+    assert not psa.short_seq_supported((2, 12, 1024, 64), (2, 12, 1024, 64),
+                                       bias=None)
+    assert psa.short_seq_supported((2, 12, 512, 64), (2, 12, 512, 64),
+                                   bias=None)

@@ -1,0 +1,68 @@
+"""Probe: pallas DMA throughput vs block shape on v5e (copy kernels).
+Usage: python tools/_attn_dma.py [iters]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+iters = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+B, nh, S, dh = 128, 12, 128, 64
+rng = np.random.default_rng(0)
+x4 = jax.device_put(jnp.asarray(
+    rng.standard_normal((B, nh, S, dh)), jnp.bfloat16))
+x3 = jax.device_put(jnp.asarray(
+    rng.standard_normal((B, S, nh * dh)), jnp.bfloat16))
+
+
+def bench(name, fn, x):
+    out = fn(x)
+    np.asarray(out.reshape(-1)[0], np.float32)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    np.asarray(out.reshape(-1)[0], np.float32)
+    dt = (time.perf_counter() - t0) / iters
+    gb = 2 * x.size * x.dtype.itemsize / 1e9
+    print(f"{name:28s} {dt*1e3:8.3f} ms   {gb/dt:7.1f} GB/s")
+
+
+def copy4(bb):
+    def kern(i_ref, o_ref):
+        o_ref[...] = i_ref[...] * 2.0
+    return jax.jit(lambda x: pl.pallas_call(
+        kern, grid=(B // bb,),
+        in_specs=[pl.BlockSpec((bb, nh, S, dh), lambda b: (b, 0, 0, 0))],
+        out_specs=pl.BlockSpec((bb, nh, S, dh), lambda b: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )(x))
+
+
+def copy3(bb):
+    def kern(i_ref, o_ref):
+        o_ref[...] = i_ref[...] * 2.0
+    return jax.jit(lambda x: pl.pallas_call(
+        kern, grid=(B // bb,),
+        in_specs=[pl.BlockSpec((bb, S, nh * dh), lambda b: (b, 0, 0))],
+        out_specs=pl.BlockSpec((bb, S, nh * dh), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )(x))
+
+
+def xla_copy(x):
+    return x * 2.0
+
+
+bench("xla copy", jax.jit(xla_copy), x4)
+for bb in (1, 4, 16):
+    bench(f"pallas [b,nh,S,dh] bb={bb}", copy4(bb), x4)
+for bb in (1, 4, 16):
+    bench(f"pallas [b,S,H] bb={bb}", copy3(bb), x3)

@@ -1,0 +1,85 @@
+"""Microbench: short-seq Pallas attention vs XLA attention at BERT shapes.
+
+Chains N applications inside ONE jit (per-dispatch tunnel overhead is
+~1.1 ms — see tools/_attn_dma.py — so per-call timing lies).
+Usage: python tools/_attn_micro.py [B] [S] [dh] [chain_len]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from paddle_tpu.ops.attention_ops import _reference_attention
+from paddle_tpu.ops.pallas_kernels import attention as psa
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+S = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+dh = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+N = int(sys.argv[4]) if len(sys.argv) > 4 else 40
+nh = 12
+sm = dh ** -0.5
+OUTER = 5
+
+rng = np.random.default_rng(0)
+q, k, v = (jax.device_put(jnp.asarray(
+    rng.standard_normal((B, nh, S, dh)), jnp.bfloat16)) for _ in range(3))
+
+
+def chain_fwd(attn_fn):
+    @jax.jit
+    def run(q, k, v):
+        def body(qc, _):
+            return attn_fn(qc, k, v).astype(qc.dtype), None
+        out, _ = jax.lax.scan(body, q, None, length=N)
+        return out
+    return run
+
+
+def chain_fwdbwd(attn_fn):
+    def loss(qc, k, v):
+        return jnp.sum(attn_fn(qc, k, v).astype(jnp.float32))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(qc, _):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(qc, k, v)
+            return (qc + 0.001 * (dq + dk + dv)).astype(qc.dtype), None
+        out, _ = jax.lax.scan(body, q, None, length=N)
+        return out
+    return run
+
+
+def bench(name, run, flops_per_app):
+    out = run(q, k, v)
+    np.asarray(out[0, 0, 0], np.float32)
+    t0 = time.perf_counter()
+    for _ in range(OUTER):
+        out = run(q, k, v)
+    np.asarray(out[0, 0, 0], np.float32)
+    dt = (time.perf_counter() - t0) / (OUTER * N)
+    print(f"{name:24s} {dt*1e3:8.3f} ms/app  ({flops_per_app/dt/1e12:6.2f} TF/s)")
+    return dt
+
+
+def pallas_attn(q, k, v):
+    return psa.short_seq_attention(q, k, v, sm_scale=sm)
+
+
+def xla_attn(q, k, v):
+    return _reference_attention(q, k, v, sm_scale=sm)
+
+
+fwd_flops = 2 * 2 * B * nh * S * S * dh
+print(f"B={B} nh={nh} S={S} dh={dh} bf16, chain {N} x {OUTER}")
+bench("xla fwd", chain_fwd(xla_attn), fwd_flops)
+bench("pallas fwd", chain_fwd(pallas_attn), fwd_flops)
+bench("xla fwd+bwd", chain_fwdbwd(xla_attn), fwd_flops * 3.5)
+bench("pallas fwd+bwd", chain_fwdbwd(pallas_attn), fwd_flops * 3.5)
+
+o1 = jax.jit(pallas_attn)(q, k, v)
+o2 = jax.jit(xla_attn)(q, k, v)
+err = float(jnp.max(jnp.abs(o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+print("max fwd err:", err)

@@ -1,0 +1,125 @@
+"""Probe: which stage of the fused-attention fwd kernel is slow on v5e.
+Variants: qk (scores only), qk_max, softmax (no PV), full, full_perhead.
+Usage: python tools/_attn_probe.py [iters]
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, nh, S, dh = 128, 12, 128, 64
+gh = 12
+iters = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+sm = dh ** -0.5
+
+rng = np.random.default_rng(0)
+q, k, v = (jax.device_put(jnp.asarray(
+    rng.standard_normal((B, nh, S, dh)), jnp.bfloat16)) for _ in range(3))
+
+
+def hb():
+    return pl.BlockSpec((1, gh, S, dh), lambda b, h: (b, h, 0, 0))
+
+
+def make(kernel, n_in=3):
+    return jax.jit(lambda *a: pl.pallas_call(
+        kernel,
+        grid=(B, nh // gh),
+        in_specs=[hb()] * n_in,
+        out_specs=hb(),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+    )(*a))
+
+
+def k_qk(q_ref, k_ref, v_ref, o_ref):
+    qq, kk = q_ref[0], k_ref[0]
+    s = jax.lax.dot_general(qq, kk, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    # reduce scores back to output shape so nothing is DCE'd
+    o_ref[0] = (s[:, :, :dh] * sm).astype(o_ref.dtype)
+
+
+def k_qk_max(q_ref, k_ref, v_ref, o_ref):
+    qq, kk = q_ref[0], k_ref[0]
+    s = jax.lax.dot_general(qq, kk, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm
+    m = jnp.max(s, axis=-1, keepdims=True)
+    o_ref[0] = (s[:, :, :dh] - m).astype(o_ref.dtype)
+
+
+def k_softmax(q_ref, k_ref, v_ref, o_ref):
+    qq, kk = q_ref[0], k_ref[0]
+    s = jax.lax.dot_general(qq, kk, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o_ref[0] = ((p / l)[:, :, :dh]).astype(o_ref.dtype)
+
+
+def k_full(q_ref, k_ref, v_ref, o_ref):
+    qq, kk, vv = q_ref[0], k_ref[0], v_ref[0]
+    s = jax.lax.dot_general(qq, kk, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p.astype(vv.dtype), vv,
+                            (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+
+
+def k_full_perhead(q_ref, k_ref, v_ref, o_ref):
+    for g in range(gh):
+        qq, kk, vv = q_ref[0, g], k_ref[0, g], v_ref[0, g]
+        s = jax.lax.dot_general(qq, kk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(p.astype(vv.dtype), vv,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        o_ref[0, g] = (o / l).astype(o_ref.dtype)
+
+
+def k_full_bf16sm(q_ref, k_ref, v_ref, o_ref):
+    qq, kk, vv = q_ref[0], k_ref[0], v_ref[0]
+    s = jax.lax.dot_general(qq, kk, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp((s - m).astype(jnp.bfloat16))
+    l = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p, vv, (((2,), (1,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+
+
+def k_copy(q_ref, k_ref, v_ref, o_ref):
+    o_ref[0] = q_ref[0] + v_ref[0]
+
+
+def bench(name, fn):
+    out = fn(q, k, v)
+    np.asarray(out[0, 0, 0], np.float32)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q, k, v)
+    np.asarray(out[0, 0, 0], np.float32)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:16s} {dt*1e3:8.3f} ms   {dt/B*1e6:6.2f} us/step")
+
+
+for name, kern in [("copy", k_copy), ("qk", k_qk), ("qk_max", k_qk_max),
+                   ("softmax", k_softmax), ("full", k_full),
+                   ("full_bf16sm", k_full_bf16sm),
+                   ("full_perhead", k_full_perhead)]:
+    bench(name, make(kern))

@@ -1,0 +1,108 @@
+"""Attention SUBLAYER probe: qkv-proj -> attention -> out-proj, fwd+bwd,
+chained in one jit. Compares:
+  a) model-style: reshape/transpose to [B,nh,S,dh], XLA einsum attention
+  b) model-style with the pallas short-seq kernel
+  c) layout-native: einsum directly on [B,S,nh,dh] (no transposes)
+  d) layout-native pallas kernel (blocks index the head dim)
+Usage: python tools/_attn_sublayer.py [B] [S] [chain]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from paddle_tpu.ops.pallas_kernels import attention as psa
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+S = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+N = int(sys.argv[3]) if len(sys.argv) > 3 else 24
+H, nh, dh = 768, 12, 64
+sm = dh ** -0.5
+OUTER = 5
+
+rng = np.random.default_rng(0)
+x0 = jax.device_put(jnp.asarray(rng.standard_normal((B, S, H)), jnp.bfloat16))
+wqkv = jax.device_put(jnp.asarray(
+    rng.standard_normal((H, 3 * H)) * 0.02, jnp.bfloat16))
+wo = jax.device_put(jnp.asarray(
+    rng.standard_normal((H, H)) * 0.02, jnp.bfloat16))
+ct = jax.device_put(jnp.asarray(rng.standard_normal((B, S, H)), jnp.bfloat16))
+
+
+def attn_model_xla(x, wqkv, wo):
+    qkv = x @ wqkv                                     # [B,S,3H]
+    qkv = qkv.reshape(B, S, 3, nh, dh).transpose(2, 0, 3, 1, 4)
+    q, k, v = qkv[0], qkv[1], qkv[2]                   # [B,nh,S,dh]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+    return o @ wo
+
+
+def attn_model_pallas(x, wqkv, wo):
+    qkv = x @ wqkv
+    qkv = qkv.reshape(B, S, 3, nh, dh).transpose(2, 0, 3, 1, 4)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    o = psa.short_seq_attention(q, k, v, sm_scale=sm)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+    return o @ wo
+
+
+def attn_native_xla(x, wqkv, wo):
+    qkv = (x @ wqkv).reshape(B, S, 3, nh, dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,S,nh,dh]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o.reshape(B, S, H) @ wo
+
+
+def attn_native_pallas(x, wqkv, wo):
+    qkv = (x @ wqkv).reshape(B, S, 3, nh, dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o = psa.bsnd_attention(q, k, v, sm_scale=sm)        # [B,S,nh,dh]
+    return o.reshape(B, S, H) @ wo
+
+
+def bench(name, f):
+    def loss(x, wqkv, wo):
+        return jnp.sum((f(x, wqkv, wo) * ct).astype(jnp.float32))
+
+    @jax.jit
+    def run(x, wqkv, wo):
+        def body(c, _):
+            x, wq, wo_ = c
+            dx, dwq, dwo = jax.grad(loss, argnums=(0, 1, 2))(x, wq, wo_)
+            return ((x + 0.001 * dx).astype(x.dtype),
+                    (wq + 0.001 * dwq).astype(wq.dtype),
+                    (wo_ + 0.001 * dwo).astype(wo_.dtype)), None
+        (xo, _, _), _ = jax.lax.scan(body, (x, wqkv, wo), None, length=N)
+        return xo
+
+    out = run(x0, wqkv, wo)
+    np.asarray(out[0, 0, 0], np.float32)
+    t0 = time.perf_counter()
+    for _ in range(OUTER):
+        out = run(x0, wqkv, wo)
+    np.asarray(out[0, 0, 0], np.float32)
+    dt = (time.perf_counter() - t0) / (OUTER * N)
+    print(f"{name:22s} {dt*1e3:8.3f} ms/sublayer(fwd+bwd)")
+    return dt
+
+
+print(f"B={B} S={S} H={H} bf16, chain {N} x {OUTER}")
+bench("model xla", attn_model_xla)
+bench("model pallas", attn_model_pallas)
+bench("native xla", attn_native_xla)
+if hasattr(psa, "bsnd_attention"):
+    bench("native pallas", attn_native_pallas)
+
+o1 = jax.jit(attn_model_xla)(x0, wqkv, wo)
+o2 = jax.jit(attn_model_pallas)(x0, wqkv, wo)
+o3 = jax.jit(attn_native_xla)(x0, wqkv, wo)
+print("pallas vs xla err:", float(jnp.max(jnp.abs((o1 - o2).astype(jnp.float32)))),
+      "native vs model err:", float(jnp.max(jnp.abs((o1 - o3).astype(jnp.float32)))))

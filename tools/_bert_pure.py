@@ -1,0 +1,143 @@
+"""Pure-JAX BERT-base step replica: what can this chip actually reach?
+
+Identical shapes to the bench (B=128, S=128, 12 layers, vocab 30522),
+bf16 matmuls, fp32 master weights + Adam, chained steps inside one jit.
+Variants via argv[1]: model | native | pallas  (attention layout/kernel).
+Usage: python tools/_bert_pure.py [variant] [chain]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from paddle_tpu.ops.pallas_kernels import attention as psa
+
+variant = sys.argv[1] if len(sys.argv) > 1 else "model"
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+B, S, H, nh, dh, L, V, F = 128, 128, 768, 12, 64, 12, 30522, 3072
+sm = dh ** -0.5
+OUTER = 3
+
+rng = np.random.default_rng(0)
+
+
+def mk(*shape, scale=0.02):
+    return jnp.asarray(rng.standard_normal(shape) * scale, jnp.float32)
+
+
+params = {
+    "emb": mk(V, H), "pos": mk(S, H),
+    "head_w": mk(H, V), "head_b": jnp.zeros((V,), jnp.float32),
+}
+for i in range(L):
+    params[f"l{i}"] = {
+        "qkv_w": mk(H, 3 * H), "qkv_b": jnp.zeros((3 * H,), jnp.float32),
+        "o_w": mk(H, H), "o_b": jnp.zeros((H,), jnp.float32),
+        "ln1_g": jnp.ones((H,), jnp.float32), "ln1_b": jnp.zeros((H,), jnp.float32),
+        "f1_w": mk(H, F), "f1_b": jnp.zeros((F,), jnp.float32),
+        "f2_w": mk(F, H), "f2_b": jnp.zeros((H,), jnp.float32),
+        "ln2_g": jnp.ones((H,), jnp.float32), "ln2_b": jnp.zeros((H,), jnp.float32),
+    }
+params = jax.device_put(params)
+
+ids = jax.device_put(jnp.asarray(
+    rng.integers(0, V, (B, S)), jnp.int32))
+labels = jax.device_put(jnp.asarray(
+    rng.integers(0, V, (B, S)), jnp.int32))
+
+
+def ln(x, g, b):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-12) * g + b).astype(x.dtype)
+
+
+def attention(x, p):
+    xb = x.astype(jnp.bfloat16)
+    qkv = xb @ p["qkv_w"].astype(jnp.bfloat16) + p["qkv_b"].astype(jnp.bfloat16)
+    if variant == "model":
+        qkv = qkv.reshape(B, S, 3, nh, dh).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+        pr = jax.nn.softmax(s.astype(jnp.float32), -1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+    elif variant == "native":
+        qkv = qkv.reshape(B, S, 3, nh, dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm
+        pr = jax.nn.softmax(s.astype(jnp.float32), -1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bkhd->bqhd", pr, v).reshape(B, S, H)
+    else:  # pallas
+        qkv = qkv.reshape(B, S, 3, nh, dh).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        o = psa.short_seq_attention(q, k, v, sm_scale=sm)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+    return o @ p["o_w"].astype(jnp.bfloat16) + p["o_b"].astype(jnp.bfloat16)
+
+
+def layer(x, p):
+    a = attention(x, p)
+    x = ln(x + a, p["ln1_g"], p["ln1_b"])
+    xb = x.astype(jnp.bfloat16)
+    h = jax.nn.gelu(xb @ p["f1_w"].astype(jnp.bfloat16)
+                    + p["f1_b"].astype(jnp.bfloat16))
+    f = h @ p["f2_w"].astype(jnp.bfloat16) + p["f2_b"].astype(jnp.bfloat16)
+    return ln(x + f, p["ln2_g"], p["ln2_b"])
+
+
+def loss_fn(params):
+    x = params["emb"][ids] + params["pos"][None, :, :]
+    x = x.astype(jnp.bfloat16)
+    for i in range(L):
+        x = layer(x, params[f"l{i}"])
+    logits = (x @ params["head_w"].astype(jnp.bfloat16)).astype(jnp.float32)
+    logits = logits + params["head_b"]
+    lse = jax.nn.logsumexp(logits, -1)
+    nll = lse - jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+    return nll.mean()
+
+
+FWD_ONLY = len(sys.argv) > 3 and sys.argv[3] == "fwd"
+
+
+@jax.jit
+def train(params, mom, vel):
+    def body(c, _):
+        params, mom, vel = c
+        if FWD_ONLY:
+            # keep the carry alive so the chain can't collapse
+            loss = loss_fn(params)
+            params = jax.tree_util.tree_map(
+                lambda p: p + 1e-9 * loss.astype(p.dtype), params)
+            return (params, mom, vel), loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        tm = jax.tree_util.tree_map
+        mom = tm(lambda g, m: 0.9 * m + 0.1 * g, grads, mom)
+        vel = tm(lambda g, v: 0.999 * v + 0.001 * g * g, grads, vel)
+        params = tm(lambda p, m, v: p - 1e-4 * m / (jnp.sqrt(v) + 1e-8),
+                    params, mom, vel)
+        return (params, mom, vel), loss
+    (params, mom, vel), losses = jax.lax.scan(body, (params, mom, vel),
+                                              None, length=N)
+    return params, mom, vel, losses
+
+
+zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+p, m, v, losses = train(params, zeros, zeros)
+np.asarray(losses[-1])
+t0 = time.perf_counter()
+for _ in range(OUTER):
+    p2, m2, v2, losses = train(p, m, v)
+np.asarray(losses[-1])
+dt = (time.perf_counter() - t0) / (OUTER * N)
+tok = B * S / dt
+# same honest MFU formula as bench.py: 6*N_matmul*T + attention
+n_mat = (L * (H * 3 * H + H * H + H * F + F * H) + H * V)
+flops = 6 * n_mat * B * S + 12 * L * B * nh * S * S * dh  # attn fwd+bwd(2.5x)
+print(f"variant={variant}  {dt*1e3:.1f} ms/step  {tok:,.0f} tok/s  "
+      f"MFU {flops/dt/197e12:.3f}")

@@ -1,0 +1,146 @@
+"""Compare XLA cost analysis of the framework's bench step vs the pure-JAX
+replica: flops + bytes accessed reveal double-compute / extra materialization.
+Usage: python tools/_cost_diff.py
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def framework_cost():
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+        ffn_size=3072, max_position=512, dropout=0.0, use_tp=False)
+    batch, seq_len = 128, 128
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        avg_loss, _ = transformer.bert_pretrain(cfg, seq_len=seq_len)
+        opt = pt.contrib.mixed_precision.decorate(
+            pt.optimizer.Adam(learning_rate=1e-4))
+        opt.minimize(avg_loss)
+
+    from __graft_entry__ import _example_feed
+    feed = _example_feed(cfg, batch, seq_len)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main_p, feed=feed)  # compile + cache
+        # grab the cached compiled fn and its arg values
+        prog_cache = exe._cache[main_p]
+        comp = next(iter(prog_cache.values()))
+        scope = pt.global_scope()
+        feed_names = sorted(feed)
+        feed_vals = tuple(feed[n] for n in feed_names)
+        ro_vals = tuple(exe._fetch_state(scope, n) for n in comp.ro_names)
+        rw_vals = tuple(exe._fetch_state(scope, n) for n in comp.rw_names)
+        key = jax.random.PRNGKey(0)
+        lowered = comp.fn.lower(feed_vals, ro_vals, rw_vals, key)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        import os
+        if os.environ.get("DUMP_HLO"):
+            open("/tmp/hlo_framework.txt", "w").write(compiled.as_text())
+    return ca
+
+
+def replica_cost():
+    import importlib
+    sys.argv = ["x", "model", "1"]
+    mod = importlib.import_module("tools._bert_pure") if False else None
+    # inline a single-step version instead (no scan) for clean cost numbers
+    B, S, H, nh, dh, L, V, F = 128, 128, 768, 12, 64, 12, 30522, 3072
+    sm = dh ** -0.5
+    rng = np.random.default_rng(0)
+
+    def mk(*shape, scale=0.02):
+        return jnp.asarray(rng.standard_normal(shape) * scale, jnp.float32)
+
+    params = {"emb": mk(V, H), "pos": mk(S, H), "head_w": mk(H, V),
+              "head_b": jnp.zeros((V,), jnp.float32)}
+    for i in range(L):
+        params[f"l{i}"] = {
+            "qkv_w": mk(H, 3 * H), "qkv_b": jnp.zeros((3 * H,)),
+            "o_w": mk(H, H), "o_b": jnp.zeros((H,)),
+            "ln1_g": jnp.ones((H,)), "ln1_b": jnp.zeros((H,)),
+            "f1_w": mk(H, F), "f1_b": jnp.zeros((F,)),
+            "f2_w": mk(F, H), "f2_b": jnp.zeros((H,)),
+            "ln2_g": jnp.ones((H,)), "ln2_b": jnp.zeros((H,)),
+        }
+    ids = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+
+    def ln(x, g, b):
+        x32 = x.astype(jnp.float32)
+        mu = x32.mean(-1, keepdims=True)
+        var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + 1e-12) * g + b).astype(x.dtype)
+
+    def layer(x, p):
+        xb = x.astype(jnp.bfloat16)
+        qkv = xb @ p["qkv_w"].astype(jnp.bfloat16) + p["qkv_b"].astype(jnp.bfloat16)
+        qkv = qkv.reshape(B, S, 3, nh, dh).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+        pr = jax.nn.softmax(s.astype(jnp.float32), -1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pr, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H)
+        a = o @ p["o_w"].astype(jnp.bfloat16) + p["o_b"].astype(jnp.bfloat16)
+        x = ln(x + a, p["ln1_g"], p["ln1_b"])
+        xb = x.astype(jnp.bfloat16)
+        h = jax.nn.gelu(xb @ p["f1_w"].astype(jnp.bfloat16) + p["f1_b"].astype(jnp.bfloat16))
+        f = h @ p["f2_w"].astype(jnp.bfloat16) + p["f2_b"].astype(jnp.bfloat16)
+        return ln(x + f, p["ln2_g"], p["ln2_b"])
+
+    def loss_fn(params):
+        x = params["emb"][ids] + params["pos"][None, :, :]
+        x = x.astype(jnp.bfloat16)
+        for i in range(L):
+            x = layer(x, params[f"l{i}"])
+        logits = (x @ params["head_w"].astype(jnp.bfloat16)).astype(jnp.float32)
+        logits = logits + params["head_b"]
+        lse = jax.nn.logsumexp(logits, -1)
+        nll = lse - jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+        return nll.mean()
+
+    def step(params, mom, vel):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        tm = jax.tree_util.tree_map
+        mom = tm(lambda g, m: 0.9 * m + 0.1 * g, grads, mom)
+        vel = tm(lambda g, v: 0.999 * v + 0.001 * g * g, grads, vel)
+        params = tm(lambda p, m, v: p - 1e-4 * m / (jnp.sqrt(v) + 1e-8),
+                    params, mom, vel)
+        return params, mom, vel, loss
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    lowered = jax.jit(step).lower(params, zeros, zeros)
+    compiled = lowered.compile()
+    import os
+    if os.environ.get("DUMP_HLO"):
+        open("/tmp/hlo_replica.txt", "w").write(compiled.as_text())
+    return compiled.cost_analysis()
+
+
+def show(tag, ca):
+    keys = ["flops", "bytes accessed", "transcendentals",
+            "bytes accessed output", "optimal_seconds"]
+    parts = []
+    for k in keys:
+        if k in ca:
+            parts.append(f"{k}={ca[k]:.3e}")
+    print(tag, "  ".join(parts))
+
+
+ca_r = replica_cost()
+show("replica  :", ca_r)
+ca_f = framework_cost()
+show("framework:", ca_f)
+for k in ("flops", "bytes accessed", "transcendentals"):
+    if k in ca_r and k in ca_f and ca_r[k]:
+        print(f"{k} ratio fw/replica: {ca_f[k]/ca_r[k]:.3f}")

@@ -1,5 +1,5 @@
 """Step-time decomposition for BERT bench config.
-usage: _decomp.py MODE   (full | fwd | nohead | nobwd)"""
+usage: _decomp.py MODE   (full | fp32 | nobwd | nohead | noattn | embmm)"""
 import sys, time, json
 import jax, numpy as np
 
