@@ -44,7 +44,10 @@ def bench_bert(on_tpu: bool, peak: float):
         cfg = transformer.TransformerConfig(
             vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
             ffn_size=3072, max_position=512, dropout=0.0, use_tp=False)
-        batch, seq_len, iters = 128, 128, 20
+        # 50 iters: the axon-tunnel host read that ends the timed region
+        # costs ~91 ms round-trip (tools/_dispatch.py), so short runs
+        # under-report throughput by 91/iters ms per step
+        batch, seq_len, iters = 128, 128, 50
     else:  # dev-box sanity run
         cfg = transformer.bert_tiny(use_tp=False)
         batch, seq_len, iters = 8, 32, 5
@@ -95,7 +98,7 @@ def bench_resnet(on_tpu: bool, peak: float):
     import paddle_tpu as pt
     from paddle_tpu.models import resnet
 
-    batch, iters = (128, 20) if on_tpu else (4, 3)
+    batch, iters = (128, 50) if on_tpu else (4, 3)
     size = 224 if on_tpu else 32
     main_p, startup = pt.Program(), pt.Program()
     with pt.program_guard(main_p, startup):
@@ -107,10 +110,14 @@ def bench_resnet(on_tpu: bool, peak: float):
             loss, acc, _ = resnet.resnet50(img, label)
         else:
             loss, acc, _ = resnet.resnet18(img, label, num_classes=10)
-        # fp32 program: XLA's TPU default already runs fp32 convs at bf16
-        # MXU speed with f32 accumulation; AMP's cast graph around
-        # batch_norm measured 2.7x SLOWER (PERF.md)
-        pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+        # AMP bf16 with batch_norm GRAY (not blacklisted): the BN kernel
+        # keeps its statistics in fp32 internally, so bf16 in/out is safe and
+        # halves the HBM traffic of the activation chain. Blacklisted-BN AMP
+        # measured 2.7x SLOWER than fp32 (cast walls); gray-BN AMP measures
+        # 1.7x FASTER (PERF.md round 3).
+        opt = pt.contrib.mixed_precision.decorate(
+            pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9))
+        opt.minimize(loss)
 
     rng = np.random.default_rng(0)
     # device-resident feed: re-feeding 77MB of host images per step would

@@ -17,6 +17,16 @@ __all__ = ["rewrite_program", "cast_var_suffix"]
 
 _LOW = {"bfloat16": "@BF16", "float16": "@FP16"}
 
+# Input slots that alias persistable running state (the op's stateful
+# outputs write back to the same vars). Harmonize-down must NEVER cast
+# these: a bf16 EMA update `mean*0.9 + x*0.1` rounds away increments below
+# ~0.4% of the running value, so the statistics quantize/stall over
+# training, and the "fp32" stat vars would flip dtype in checkpoints.
+_STATE_SLOTS = {
+    "batch_norm": {"Mean", "Variance"},
+    "fake_quantize_dequantize_moving_average_abs_max": {"InScale"},
+}
+
 
 def cast_var_suffix(dest_dtype: str) -> str:
     return _LOW.get(dest_dtype, "@LOW")
@@ -67,7 +77,10 @@ def _mixed_float_inputs(block, op) -> bool:
     """True when the op reads BOTH a low-precision and an fp32 float input —
     the case where jnp promotion would silently drag the activation back up."""
     seen = set()
-    for names in op.inputs.values():
+    exempt = _STATE_SLOTS.get(op.type, ())
+    for slot, names in op.inputs.items():
+        if slot in exempt:
+            continue
         for n in names:
             if not n or not block.has_var(n):
                 continue
@@ -106,7 +119,10 @@ def _rewrite_block(block, amp_lists, dest_dtype):
             i += 1
             continue
         inserted_here = 0
+        exempt = _STATE_SLOTS.get(op.type, ())
         for slot, names in list(op.inputs.items()):
+            if slot in exempt:
+                continue
             new_names = []
             for name in names:
                 if not name:

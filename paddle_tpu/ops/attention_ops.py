@@ -83,7 +83,13 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0,
             and psa.short_seq_supported(q.shape, k.shape, bias)):
         return psa.short_seq_attention(q, k, v, causal=causal,
                                        sm_scale=float(sm_scale))
-    if (_on_tpu() and sq > 1024 and _block_multiple_ok(sq)
+    # an O(S)-memory kernel is mandatory past S=1024 (the [S,S] scores
+    # outgrow the chip) and honored whenever the caller asked for one
+    # (`use_pallas`) but the short-seq kernel's gate rejected the shape —
+    # falling to the O(S^2) reference there would silently undo the flag's
+    # documented purpose (memory-bound configs).
+    if (_on_tpu() and (sq > 1024 or (use_pallas and sq > 512))
+            and _block_multiple_ok(sq)
             and _block_multiple_ok(sk) and q.dtype != jnp.float64):
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 

@@ -187,7 +187,9 @@ def _make(sm_scale: float, causal: bool, interpret: bool):
 def short_seq_attention(q, k, v, causal=False, sm_scale=1.0):
     """Fused attention for VMEM-resident sequence lengths.
 
-    q, k, v: [B, nh, S, dh] (S == Sk, S % 128 == 0, S <= 1024). Returns
+    q, k, v: [B, nh, S, dh] (S == Sk, S % 128 == 0, S <= 512 — the bwd
+    kernel's ~5 fp32 [S,S] intermediates outgrow VMEM past that; callers
+    must gate on `short_seq_supported`). Returns
     [B, nh, S, dh] in q's dtype. Differentiable (fused Pallas backward that
     saves no score-sized residuals — softmax is recomputed on-chip).
     """

@@ -1,0 +1,45 @@
+"""1x1 conv as lax.conv vs reshaped matmul, inside one jit."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+def drain(x): return np.asarray(_drain(x))
+
+B = 128
+K_INNER = 20
+SHAPES = [(64, 64, 56, 56), (64, 256, 56, 56), (256, 64, 56, 56),
+          (512, 128, 28, 28), (1024, 256, 14, 14), (2048, 512, 7, 7)]
+for (ci, co, h, w) in SHAPES:
+    fl = 2 * B * co * ci * h * w * K_INNER
+    x = jnp.full((B, h, w, ci), 0.5, jnp.bfloat16)
+    wt = jnp.full((1, 1, ci, co), 0.001, jnp.bfloat16)
+    wm = jnp.full((ci, co), 0.001, jnp.bfloat16)
+    wb = jnp.full((co, ci), 0.001, jnp.bfloat16)  # back-projection to keep channel count
+
+    @jax.jit
+    def f_conv(x, wt, wb):
+        def body(c, _):
+            y = jax.lax.conv_general_dilated(c, wt, (1, 1), [(0, 0)] * 2,
+                                             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return jnp.einsum("bhwd,dc->bhwc", y, wb) * 0.01, None
+        y, _ = jax.lax.scan(body, x, None, length=K_INNER)
+        return y
+
+    @jax.jit
+    def f_mm(x, wm, wb):
+        def body(c, _):
+            y = c.reshape(-1, ci) @ wm
+            return (y @ wb * 0.01).reshape(B, h, w, ci), None
+        y, _ = jax.lax.scan(body, x, None, length=K_INNER)
+        return y
+
+    for name, f, args in (("conv", f_conv, (x, wt, wb)), ("mm  ", f_mm, (x, wm, wb))):
+        drain(f(*args))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            y = f(*args)
+        drain(y)
+        dt = (time.perf_counter() - t0) / 5
+        # fl counts only the forward 1x1; the back-projection doubles it
+        print(f"{ci:>4}->{co:<4} {h:>2}x{w:<2} {name}: {dt/K_INNER*1e3:7.3f} ms {2*fl/dt/1e12:6.1f} TF/s", flush=True)

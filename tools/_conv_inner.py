@@ -1,0 +1,40 @@
+"""Pure conv kernel time: chain K convs inside ONE jit to amortize dispatch."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+def drain(x): return np.asarray(_drain(x))
+
+B = 128
+K_INNER = 20
+SHAPES = [
+    (64, 64, 56, 56, 3),
+    (256, 256, 56, 56, 3),
+    (128, 128, 28, 28, 3),
+    (512, 512, 28, 28, 3),
+    (256, 256, 14, 14, 3),
+    (512, 512, 7, 7, 3),
+    (64, 64, 56, 56, 1),
+    (512, 512, 7, 7, 1),
+]
+for (ci, co, h, w, k) in SHAPES:
+    fl = 2 * B * co * ci * k * k * h * w * K_INNER
+    x = jnp.full((B, h, w, ci), 0.5, jnp.bfloat16)
+    wt = jnp.full((k, k, ci, co), 0.001, jnp.bfloat16)
+
+    @jax.jit
+    def f(x, wt):
+        def body(c, _):
+            y = jax.lax.conv_general_dilated(
+                c, wt, (1, 1), [(k//2, k//2)]*2,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return y * 0.01, None
+        y, _ = jax.lax.scan(body, x, None, length=K_INNER)
+        return y
+    drain(f(x, wt))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        y = f(x, wt)
+    drain(y)
+    dt = (time.perf_counter() - t0) / 5
+    print(f"{ci:>4}->{co:<4} {h:>3}x{w:<3} k{k}: {dt/K_INNER*1e3:7.3f} ms/conv {fl/dt/1e12:6.1f} TF/s", flush=True)

@@ -1,0 +1,44 @@
+"""NCHW vs NHWC conv layout microbench on ResNet-50 shapes."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+
+def drain(x):
+    return np.asarray(_drain(x))
+
+B = 128
+SHAPES = [
+    (3, 64, 224, 224, 7, 2),    # stem
+    (64, 256, 56, 56, 1, 1),
+    (256, 64, 56, 56, 1, 1),
+    (64, 64, 56, 56, 3, 1),     # actual RN50 stage-1 3x3
+    (256, 256, 56, 56, 3, 1),
+    (128, 128, 28, 28, 3, 1),
+    (512, 512, 28, 28, 3, 1),
+    (256, 256, 14, 14, 3, 1),
+    (512, 512, 7, 7, 3, 1),
+    (2048, 512, 7, 7, 1, 1),
+]
+N = 30
+for (ci, co, h, w, k, s) in SHAPES:
+    ho, wo = h // s, w // s
+    fl = 2 * B * co * ci * k * k * ho * wo
+    res = []
+    for dn in (("NCHW", "OIHW", "NCHW"), ("NHWC", "HWIO", "NHWC")):
+        if dn[0] == "NCHW":
+            x = jnp.full((B, ci, h, w), 0.5, jnp.bfloat16)
+            wt = jnp.full((co, ci, k, k), 0.001, jnp.bfloat16)
+        else:
+            x = jnp.full((B, h, w, ci), 0.5, jnp.bfloat16)
+            wt = jnp.full((k, k, ci, co), 0.001, jnp.bfloat16)
+        f = jax.jit(lambda x, wt, dn=dn, s=s, k=k: jax.lax.conv_general_dilated(
+            x, wt, (s, s), [(k//2, k//2)]*2, dimension_numbers=dn))
+        drain(f(x, wt))  # warm conv + drain for this shape
+        t0 = time.perf_counter()
+        for _ in range(N):
+            y = f(x, wt)
+        drain(y)
+        res.append((time.perf_counter() - t0) / N)
+    t1, t2 = res
+    print(f"{ci:>4}->{co:<4} {h:>3}x{w:<3} k{k} s{s}: NCHW {t1*1e3:7.2f} ms {fl/t1/1e12:6.1f} TF/s | NHWC {t2*1e3:7.2f} ms {fl/t2/1e12:6.1f} TF/s", flush=True)

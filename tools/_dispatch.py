@@ -1,0 +1,26 @@
+"""Axon tunnel dispatch overhead: N chained no-op-ish calls, total wall time."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+def drain(x): return np.asarray(_drain(x))
+
+x = jnp.full((1024, 1024), 0.5, jnp.bfloat16)
+
+f = jax.jit(lambda c: c * jnp.asarray(0.999, jnp.bfloat16) + jnp.asarray(0.001, jnp.bfloat16))
+drain(f(x))
+for N in (1, 5, 20, 50):
+    y = x
+    t0 = time.perf_counter()
+    for _ in range(N):
+        y = f(y)
+    drain(y)
+    dt = time.perf_counter() - t0
+    print(f"N={N:>3}: total {dt*1e3:8.2f} ms, per-call {dt/N*1e3:7.2f} ms", flush=True)
+
+# and: how long does a bare drain of an already-materialized array take?
+t0 = time.perf_counter()
+for _ in range(10):
+    drain(x)
+print(f"drain alone: {(time.perf_counter()-t0)/10*1e3:.2f} ms", flush=True)

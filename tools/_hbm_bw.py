@@ -1,0 +1,28 @@
+"""Raw HBM bandwidth probe: big elementwise scale inside scan."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+def drain(x): return np.asarray(_drain(x))
+
+for mb in (64, 256, 1024):
+    n = mb * 1024 * 1024 // 2  # bf16 elements
+    x = jnp.full((n,), 0.5, jnp.bfloat16)
+    K = 20
+
+    @jax.jit
+    def f(x):
+        def body(c, _):
+            return c * jnp.asarray(1.000001, jnp.bfloat16), None
+        y, _ = jax.lax.scan(body, x, None, length=K)
+        return y
+
+    drain(f(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        y = f(x)
+    drain(y)
+    dt = (time.perf_counter() - t0) / 5 / K
+    bw = 2 * mb / 1024 / dt  # read + write, GB/s
+    print(f"{mb:>5} MB scale: {dt*1e3:7.3f} ms/iter, {bw:6.0f} GB/s", flush=True)

@@ -1,0 +1,50 @@
+"""ResNet-50 variants: fp32 vs AMP (gray batch_norm) at batch 128/256."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, numpy as np
+
+
+def run(batch, amp, momentum=True):
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+    from paddle_tpu import layers as L
+
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        img = L.data(name="img", shape=[3, 224, 224], dtype="float32")
+        label = L.data(name="label", shape=[1], dtype="int64")
+        loss, acc, _ = resnet.resnet50(img, label)
+        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        if amp:
+            opt = pt.contrib.mixed_precision.decorate(opt)
+        opt.minimize(loss)
+
+    rng = np.random.default_rng(0)
+    feed = {
+        "img": jax.device_put(rng.standard_normal((batch, 3, 224, 224), dtype=np.float32)),
+        "label": jax.device_put(rng.integers(0, 1000, (batch, 1)).astype(np.int32)),
+    }
+    drain = main_p.all_parameters()[-1].name
+    exe = pt.Executor()
+    iters = 20
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        exe.run(main_p, feed=feed)
+        np.asarray(pt.global_scope().find_var(drain))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            exe.run(main_p, feed=feed)
+        np.asarray(pt.global_scope().find_var(drain))
+        dt = (time.perf_counter() - t0) / iters
+        (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv))), "loss blew up"
+    img_s = batch / dt
+    mfu = (3 * 4.089e9 * img_s) / 197e12
+    print(f"batch={batch} amp={amp}: {dt*1e3:.1f} ms/step, {img_s:.0f} img/s, MFU {mfu*100:.1f}%", flush=True)
+
+
+if __name__ == "__main__":
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    amp = sys.argv[2] == "amp" if len(sys.argv) > 2 else False
+    run(batch, amp)

@@ -1,0 +1,133 @@
+"""Pure-JAX ResNet-50 train step ceiling probe: bf16 NHWC, momentum SGD.
+
+Establishes what the chip+XLA can do on this model independent of the
+framework path. Usage: python tools/_rn_pure.py [batch] [nchw|nhwc] [f32|bf16]
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from functools import partial
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+LAYOUT = sys.argv[2] if len(sys.argv) > 2 else "nhwc"
+DT = jnp.bfloat16 if (len(sys.argv) <= 3 or sys.argv[3] == "bf16") else jnp.float32
+
+NHWC = LAYOUT == "nhwc"
+DN = ("NHWC", "HWIO", "NHWC") if NHWC else ("NCHW", "OIHW", "NCHW")
+CAX = 3 if NHWC else 1
+
+rng = np.random.default_rng(0)
+
+
+def conv_w(k, ci, co):
+    w = rng.standard_normal((k, k, ci, co), dtype=np.float32) * np.sqrt(2.0 / (k * k * ci))
+    if not NHWC:
+        w = w.transpose(3, 2, 0, 1)
+    return jnp.asarray(w, DT)
+
+
+def conv(x, w, s=1):
+    k = w.shape[0] if NHWC else w.shape[2]
+    return jax.lax.conv_general_dilated(
+        x, w, (s, s), [(k // 2, k // 2)] * 2, dimension_numbers=DN)
+
+
+def bn(x, p):
+    scale, bias = p
+    xf = x.astype(jnp.float32)
+    axes = tuple(i for i in range(4) if i != CAX)
+    m = jnp.mean(xf, axis=axes)
+    v = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(m)
+    sh = [1, 1, 1, 1]; sh[CAX] = -1
+    y = (xf - m.reshape(sh)) / jnp.sqrt(v.reshape(sh) + 1e-5)
+    return (y * scale.reshape(sh) + bias.reshape(sh)).astype(x.dtype)
+
+
+def make_params():
+    depths = [3, 4, 6, 3]
+    chans = [64, 128, 256, 512]
+    P = {"stem": (conv_w(7, 3, 64), (jnp.ones(64), jnp.zeros(64)))}
+    ci = 64
+    for si, (d, c) in enumerate(zip(depths, chans)):
+        for bi in range(d):
+            pre = f"s{si}b{bi}"
+            co = c * 4
+            stride = 2 if (bi == 0 and si > 0) else 1
+            blk = {
+                "c1": conv_w(1, ci, c), "b1": (jnp.ones(c), jnp.zeros(c)),
+                "c2": conv_w(3, c, c), "b2": (jnp.ones(c), jnp.zeros(c)),
+                "c3": conv_w(1, c, co), "b3": (jnp.ones(co), jnp.zeros(co)),
+            }
+            if ci != co:
+                blk["proj"] = conv_w(1, ci, co)
+                blk["bproj"] = (jnp.ones(co), jnp.zeros(co))
+            blk["stride"] = stride
+            P[pre] = blk
+            ci = co
+    P["fc"] = (jnp.asarray(rng.standard_normal((2048, 1000), dtype=np.float32) * 0.01, DT),
+               jnp.zeros(1000, DT))
+    return P
+
+
+STRIDES = {}
+
+def forward(P, x, labels):
+    x = conv(x, P["stem"][0], 2)
+    x = jax.nn.relu(bn(x, P["stem"][1]))
+    window = (1, 3, 3, 1) if NHWC else (1, 1, 3, 3)
+    strides = (1, 2, 2, 1) if NHWC else (1, 1, 2, 2)
+    pads = [(0, 0), (1, 1), (1, 1), (0, 0)] if NHWC else [(0, 0), (0, 0), (1, 1), (1, 1)]
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
+    for si, d in enumerate([3, 4, 6, 3]):
+        for bi in range(d):
+            blk = P[f"s{si}b{bi}"]
+            stride = STRIDES[f"s{si}b{bi}"]
+            idn = x
+            y = jax.nn.relu(bn(conv(x, blk["c1"], 1), blk["b1"]))
+            y = jax.nn.relu(bn(conv(y, blk["c2"], stride), blk["b2"]))
+            y = bn(conv(y, blk["c3"], 1), blk["b3"])
+            if "proj" in blk:
+                idn = bn(conv(idn, blk["proj"], stride), blk["bproj"])
+            x = jax.nn.relu(y + idn)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2) if NHWC else (2, 3))
+    w, b = P["fc"]
+    logits = x.astype(DT) @ w + b
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(lsm, labels[:, None], axis=1))
+
+
+def main():
+    P = make_params()
+    for k, v in list(P.items()):
+        if isinstance(v, dict):
+            STRIDES[k] = v.pop("stride")
+
+    x = jnp.asarray(rng.standard_normal((BATCH, 224, 224, 3) if NHWC else (BATCH, 3, 224, 224),
+                                        dtype=np.float32), DT)
+    labels = jnp.asarray(rng.integers(0, 1000, BATCH).astype(np.int32))
+
+    mom = jax.tree.map(jnp.zeros_like, P)
+
+    @jax.jit
+    def step(P, mom, x, labels):
+        loss, g = jax.value_and_grad(forward)(P, x, labels)
+        mom = jax.tree.map(lambda m, gg: 0.9 * m + gg.astype(m.dtype), mom, g)
+        P = jax.tree.map(lambda p, m: p - (0.1 * m).astype(p.dtype), P, mom)
+        return P, mom, loss
+
+    _drain = jax.jit(lambda v: v.reshape(-1)[0])
+    P, mom, loss = step(P, mom, x, labels)
+    np.asarray(_drain(P["fc"][1]))
+    N = 20
+    t0 = time.perf_counter()
+    for _ in range(N):
+        P, mom, loss = step(P, mom, x, labels)
+    np.asarray(_drain(P["fc"][1]))
+    dt = (time.perf_counter() - t0) / N
+    img_s = BATCH / dt
+    mfu = 3 * 4.089e9 * img_s / 197e12
+    print(f"pure-jax RN50 {LAYOUT} {DT.__name__} batch={BATCH}: {dt*1e3:.1f} ms/step, "
+          f"{img_s:.0f} img/s, MFU {mfu*100:.1f}%", flush=True)
+
+
+main()
