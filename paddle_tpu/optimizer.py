@@ -1080,9 +1080,15 @@ class PipelineOptimizer:
     full schedule: per-microbatch forward, rematerialized backward with
     gradient accumulation, one inner-optimizer step.
 
-    `place_list`/`concurrency_list`/`queue_size`/`start_cpu_core_id` are
-    accepted for reference API parity; on this runtime XLA async dispatch
-    replaces section threads and scope queues.
+    `place_list` maps one device per stage (reference SectionConfig places,
+    trainer_desc.proto:74): stage parameters/optimizer state live on that
+    device, boundary tensors transfer device-to-device, and the microbatch
+    loop runs in clock-cycle order so stages overlap (SectionWorker
+    concurrency via XLA async dispatch). Entries: jax.Device, int ordinal,
+    or TPUPlace/CUDAPlace-style objects with `device_id`.
+    `concurrency_list`/`queue_size`/`start_cpu_core_id` are accepted for
+    reference API parity; XLA async dispatch replaces section threads and
+    scope queues.
     """
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
@@ -1090,6 +1096,7 @@ class PipelineOptimizer:
                  start_cpu_core_id=0, num_microbatches=4):
         self._inner_opt = optimizer
         self._cut_list = cut_list or []
+        self._place_list = place_list
         self._num_microbatches = num_microbatches
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
@@ -1108,7 +1115,7 @@ class PipelineOptimizer:
         program = loss.block.program
         program._pipeline = build_pipeline_plan(
             program, loss, cuts, self._inner_opt, self._num_microbatches,
-            startup_program)
+            startup_program, devices=self._place_list)
         return [], []
 
 

@@ -19,10 +19,16 @@ stage-by-stage, all microbatches backward in reverse, gradient accumulation,
 then one optimizer step — numerically equal to one large-batch step when the
 loss is a mean (mean of equal-size microbatch means == full-batch mean).
 
-Asynchronous XLA dispatch overlaps stage compute; cross-stage tensors stay
-jax.Arrays (no host round-trip). Stage-to-device placement over a `pp` mesh
-axis is planned on top of this schedule; single-device GPipe already provides
-the memory benefit (peak activations / num_microbatches).
+Stage-to-device placement (`devices=`): each stage's programs run on its own
+device from the `pp` axis — stage parameters and optimizer state are
+device_put once, cross-stage boundary tensors transfer device-to-device
+(jax.Arrays, no host round-trip; ICI on real hardware), and the microbatch
+loop dispatches in GPipe clock-cycle order (cycle t runs stage s on
+microbatch t-s), so stage s computes microbatch m while stage s+1 computes
+m-1 — the SectionWorker concurrency (reference trainer.h:110,
+pipeline_trainer.cc) carried by XLA async dispatch instead of section
+threads + scope queues. Without `devices` the same schedule runs on one
+device and buys only activation memory (peak / num_microbatches).
 
 Known departure: the backward replay re-draws RNG (dropout masks differ
 between forward and recompute). Use dropout only where the estimator may be
@@ -135,9 +141,43 @@ class _Stage:
         self.update_feed: dict[str, str] = {}     # param -> update-prog grad feed
 
 
+def resolve_devices(place_list, n_stages: int):
+    """Map a reference-style place_list to one jax.Device per stage.
+
+    Entries may be jax.Device, an int device ordinal, or Place objects
+    carrying a `device_id` (TPUPlace/CUDAPlace parity). None -> no placement
+    (single-device GPipe)."""
+    import jax
+
+    if place_list is None:
+        return None
+    if len(place_list) != n_stages:
+        raise ValueError(
+            f"place_list has {len(place_list)} entries for {n_stages} "
+            "pipeline stages (one device per stage)")
+    pool = jax.devices()
+    out = []
+    for p in place_list:
+        if hasattr(p, "id") and hasattr(p, "platform"):  # jax.Device
+            out.append(p)
+        elif isinstance(p, (int, np.integer)):
+            out.append(pool[int(p)])
+        elif hasattr(p, "device_id"):
+            out.append(pool[p.device_id])
+        elif type(p).__name__ == "CPUPlace":
+            out.append(pool[0])
+        else:
+            raise TypeError(
+                f"place_list entry {p!r} is not a jax.Device, int ordinal, "
+                "CPUPlace, or a Place with `device_id` — refusing to guess "
+                "(a silent default would collapse stages onto one device)")
+    return out
+
+
 def build_pipeline_plan(program: Program, loss: Variable, cut_vars,
                         inner_opt, num_microbatches: int,
-                        startup_program: Program | None = None):
+                        startup_program: Program | None = None,
+                        devices=None):
     """Split `program` (forward-only) at `cut_vars` into a PipelinePlan."""
     from ..backward import gradients
 
@@ -273,7 +313,8 @@ def build_pipeline_plan(program: Program, loss: Variable, cut_vars,
                 pairs.append((pv, gv))
             opt.apply_gradients(pairs)
 
-    return PipelinePlan(stages, loss.name, num_microbatches)
+    return PipelinePlan(stages, loss.name, num_microbatches,
+                        devices=resolve_devices(devices, n_stages))
 
 
 def _is_float(v: Variable) -> bool:
@@ -286,10 +327,64 @@ class PipelinePlan:
     """Executable GPipe schedule over the stage programs (the
     PipelineTrainer/SectionWorker equivalent, host-driven)."""
 
-    def __init__(self, stages: list[_Stage], loss_name: str, num_microbatches: int):
+    def __init__(self, stages: list[_Stage], loss_name: str,
+                 num_microbatches: int, devices=None):
         self.stages = stages
         self.loss_name = loss_name
         self.num_microbatches = num_microbatches
+        self.devices = devices
+        # dispatch order of the last run_step, [("f"|"b", stage, microbatch)]
+        # — observable evidence of the clock-cycle interleave (tests assert
+        # stage s+1 starts before stage s drains; the reference's analogue is
+        # SectionWorker threads consuming scope queues concurrently)
+        self.last_dispatch: list[tuple] = []
+        if devices is not None:
+            self._check_no_cross_stage_params()
+
+    def _check_no_cross_stage_params(self):
+        owner: dict[str, int] = {}
+        for s, stage in enumerate(self.stages):
+            for p in stage.param_names:
+                if p in owner:
+                    raise NotImplementedError(
+                        f"parameter '{p}' is read by pipeline stages "
+                        f"{owner[p]} and {s}; tied weights across "
+                        "device-placed stages are not supported (each "
+                        "parameter must live on exactly one stage device)")
+                owner[p] = s
+
+    def _to_dev(self, v, dev):
+        import jax
+
+        if dev is None:
+            return v
+        if isinstance(v, jax.Array) and dev not in v.devices():
+            return jax.device_put(v, dev)
+        return v
+
+    def _place_stage_state(self, scope):
+        """device_put each stage's scope-resident state (params, BN stats,
+        optimizer accumulators — everything its programs read or write) onto
+        the stage's device, once per value. Donated updates keep results on
+        the same device, so this is a no-op after the first step."""
+        import jax
+
+        if not hasattr(self, "_stage_state_names"):
+            self._stage_state_names = []
+            for stage in self.stages:
+                names: set[str] = set()
+                for prog in (stage.fwd, stage.bwd, stage.update):
+                    if prog is None:
+                        continue
+                    for op in prog.global_block.ops:
+                        names.update(n for n in op.input_names if n)
+                        names.update(n for n in op.output_names if n)
+                self._stage_state_names.append(sorted(names))
+        for names, dev in zip(self._stage_state_names, self.devices):
+            for n in names:
+                v = scope.find_var(n)
+                if isinstance(v, jax.Array) and dev not in v.devices():
+                    scope.set_var(n, jax.device_put(v, dev))
 
     def run_step(self, exe, scope, feed: dict, fetch_names: list[str]):
         M = self.num_microbatches
@@ -328,62 +423,88 @@ class PipelinePlan:
                         f"fetch '{name}' not found in any pipeline stage")
                 fetch_stage[name] = holder
 
-        # --- forward: all microbatches stage-by-stage (GPipe fill) ----------
-        stash: list[dict[str, Any]] = [dict() for _ in range(M)]
-        fetched: dict[str, list] = {n: [] for n in fetch_names}
-        for s, stage in enumerate(self.stages):
+        S = len(self.stages)
+        devs = self.devices or [None] * S
+        if self.devices is not None:
+            self._place_stage_state(scope)
+        self.last_dispatch = []
+
+        def _fwd_one(s, m, stash, fetched):
+            stage = self.stages[s]
             wanted = list(stage.out_names) + [
                 n for n in fetch_names
                 if fetch_stage[n] == s and n not in stage.out_names]
-            for m in range(M):
-                f = {n: micro_feeds[m][n] for n in stage.ext_inputs
-                     if n in micro_feeds[m]}
-                f.update({n: stash[m][n] for n in stage.ext_inputs
-                          if n in stash[m]})
-                missing = [n for n in stage.ext_inputs if n not in f]
-                if missing:
-                    raise KeyError(
-                        f"pipeline stage {s} needs feeds {missing}")
-                outs = exe.run(stage.fwd, feed=f, fetch_list=wanted,
-                               scope=scope, return_numpy=False)
-                for n, v in zip(wanted, outs):
-                    if n in stage.out_names:
-                        stash[m][n] = v
-                    if n in fetched:
-                        fetched[n].append(v)
+            f = {n: micro_feeds[m][n] for n in stage.ext_inputs
+                 if n in micro_feeds[m]}
+            f.update({n: self._to_dev(stash[m][n], devs[s])
+                      for n in stage.ext_inputs if n in stash[m]})
+            missing = [n for n in stage.ext_inputs if n not in f]
+            if missing:
+                raise KeyError(f"pipeline stage {s} needs feeds {missing}")
+            outs = exe.run(stage.fwd, feed=f, fetch_list=wanted,
+                           scope=scope, return_numpy=False)
+            self.last_dispatch.append(("f", s, m))
+            for n, v in zip(wanted, outs):
+                if n in stage.out_names:
+                    stash[m][n] = v
+                if n in fetched:
+                    fetched[n].append(v)
 
-        # --- backward: reverse stages, accumulate param grads ---------------
-        grad_acc: dict[str, Any] = {}
-        grad_stash: list[dict[str, Any]] = [dict() for _ in range(M)]
-        for s in range(len(self.stages) - 1, -1, -1):
+        # --- forward: GPipe clock cycles — cycle t dispatches stage s on
+        # microbatch t-s, so with device placement stage s computes
+        # microbatch m while stage s+1 computes m-1 (async XLA dispatch on
+        # distinct devices = the SectionWorker overlap)
+        stash: list[dict[str, Any]] = [dict() for _ in range(M)]
+        fetched: dict[str, list] = {n: [] for n in fetch_names}
+        for t in range(S + M - 1):
+            for s in range(S):
+                m = t - s
+                if 0 <= m < M:
+                    _fwd_one(s, m, stash, fetched)
+
+        def _bwd_one(s, m, stash, grad_stash, grad_acc):
             stage = self.stages[s]
             pg_names = sorted(stage.param_grad_names.items())
             ig_names = sorted(stage.in_grad_names.items())
             wanted = [g for _, g in pg_names] + [g for _, g in ig_names]
             if not wanted:
-                continue
-            for m in range(M):
-                f = {n: micro_feeds[m][n] for n in stage.ext_inputs
-                     if n in micro_feeds[m]}
-                f.update({n: stash[m][n] for n in stage.ext_inputs
-                          if n in stash[m]})
-                for n in stage.out_names:
-                    g = grad_stash[m].get(n)
-                    if g is None:
-                        ov = stage.fwd.global_block.var(n)
-                        shape = [d if d != -1 else _infer_batch(stash[m][n])
-                                 for d in ov.shape]
-                        g = np.zeros(shape, ov.np_dtype)
-                    f[n + _GRAD_IN_SUFFIX] = g
-                outs = exe.run(stage.bwd, feed=f, fetch_list=wanted,
-                               scope=scope, return_numpy=False)
-                outs = list(outs)
-                for (p, _), v in zip(pg_names, outs[: len(pg_names)]):
-                    prev = grad_acc.get(p)
-                    grad_acc[p] = v if prev is None else prev + v
-                for (n, _), v in zip(ig_names, outs[len(pg_names):]):
-                    prev = grad_stash[m].get(n)
-                    grad_stash[m][n] = v if prev is None else prev + v
+                return
+            f = {n: micro_feeds[m][n] for n in stage.ext_inputs
+                 if n in micro_feeds[m]}
+            f.update({n: self._to_dev(stash[m][n], devs[s])
+                      for n in stage.ext_inputs if n in stash[m]})
+            for n in stage.out_names:
+                g = grad_stash[m].get(n)
+                if g is None:
+                    ov = stage.fwd.global_block.var(n)
+                    shape = [d if d != -1 else _infer_batch(stash[m][n])
+                             for d in ov.shape]
+                    g = np.zeros(shape, ov.np_dtype)
+                f[n + _GRAD_IN_SUFFIX] = self._to_dev(g, devs[s])
+            outs = exe.run(stage.bwd, feed=f, fetch_list=wanted,
+                           scope=scope, return_numpy=False)
+            self.last_dispatch.append(("b", s, m))
+            outs = list(outs)
+            for (p, _), v in zip(pg_names, outs[: len(pg_names)]):
+                prev = grad_acc.get(p)
+                grad_acc[p] = v if prev is None else prev + v
+            for (n, _), v in zip(ig_names, outs[len(pg_names):]):
+                prev = grad_stash[m].get(n)
+                if prev is not None:
+                    v = self._to_dev(v, _device_of(prev))
+                grad_stash[m][n] = v if prev is None else prev + v
+
+        # --- backward: reverse clock cycles (stage S-1 leads, stage s runs
+        # microbatch m at cycle (S-1-s)+m); every consumer stage s' > s of a
+        # boundary var finishes microbatch m strictly before stage s needs
+        # its cotangent. Param grads accumulate on the stage's device.
+        grad_acc: dict[str, Any] = {}
+        grad_stash: list[dict[str, Any]] = [dict() for _ in range(M)]
+        for t in range(S + M - 1):
+            for s in range(S - 1, -1, -1):
+                m = t - (S - 1 - s)
+                if 0 <= m < M:
+                    _bwd_one(s, m, stash, grad_stash, grad_acc)
 
         # --- update: one optimizer step on mean-of-microbatch grads ---------
         inv = 1.0 / M
@@ -409,3 +530,12 @@ class PipelinePlan:
 
 def _infer_batch(arr) -> int:
     return int(np.asarray(arr).shape[0])
+
+
+def _device_of(arr):
+    import jax
+
+    if isinstance(arr, jax.Array):
+        (dev,) = arr.devices() if len(arr.devices()) == 1 else (None,)
+        return dev
+    return None
