@@ -25,7 +25,8 @@ from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from ..parallel.sharding import annotate_sharding
 
 __all__ = ["TransformerConfig", "bert_base", "bert_tiny", "transformer_encoder",
-           "bert_pretrain", "multi_head_attention", "positionwise_ffn"]
+           "bert_pretrain", "multi_head_attention", "positionwise_ffn",
+           "wmt_base", "transformer_wmt", "cross_attention"]
 
 
 @dataclass
@@ -229,3 +230,133 @@ def bert_pretrain(cfg: TransformerConfig, seq_len: int = 128):
 def _const_eps():
     from ..layers.tensor import fill_constant
     return fill_constant(shape=[], dtype="float32", value=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Encoder-decoder Transformer (WMT en-de, BASELINE config 3; reference: the
+# fluid book machine-translation transformer model family)
+# ---------------------------------------------------------------------------
+
+
+def wmt_base() -> TransformerConfig:
+    """Transformer-base: 6+6 layers, d_model 512, 8 heads, ffn 2048, joint
+    37k BPE vocab (Vaswani et al. table 3 'base')."""
+    return TransformerConfig(vocab_size=37000, hidden_size=512, num_layers=6,
+                             num_heads=8, ffn_size=2048, max_position=256,
+                             dropout=0.1, use_tp=False)
+
+
+def cross_attention(x, mem, cfg: TransformerConfig, attn_bias=None,
+                    name="xattn"):
+    """Encoder-decoder attention: queries from the decoder stream `x`
+    [B,St,H], keys/values from encoder memory `mem` [B,Ss,H]."""
+    St, Ss, H = x.shape[-2], mem.shape[-2], cfg.hidden_size
+    nh, dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+
+    q = _fc(x, H, name + ".q", w_spec=(None, MODEL_AXIS),
+            b_spec=(MODEL_AXIS,), cfg=cfg)
+    kv = _fc(mem, 2 * H, name + ".kv", w_spec=(None, MODEL_AXIS),
+             b_spec=(MODEL_AXIS,), cfg=cfg)
+    q = L.transpose(L.reshape(q, shape=[0, St, nh, dh]), perm=[0, 2, 1, 3])
+    kv = L.transpose(L.reshape(kv, shape=[0, Ss, 2, nh, dh]),
+                     perm=[2, 0, 3, 1, 4])
+    k = L.squeeze(L.slice(kv, axes=[0], starts=[0], ends=[1]), axes=[0])
+    v = L.squeeze(L.slice(kv, axes=[0], starts=[1], ends=[2]), axes=[0])
+    if attn_bias is None and not cfg.dropout:
+        ctxv = L.fused_attention(q, k, v, causal=False, sm_scale=dh ** -0.5,
+                                 use_pallas=cfg.use_flash_attention)
+    else:
+        scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+        if attn_bias is not None:
+            scores = L.elementwise_add(scores, attn_bias)
+        probs = L.softmax(scores)
+        if cfg.dropout:
+            probs = L.dropout(probs, dropout_prob=cfg.dropout,
+                              dropout_implementation="upscale_in_train")
+        ctxv = L.matmul(probs, v)
+    ctxv = L.reshape(L.transpose(ctxv, perm=[0, 2, 1, 3]), shape=[0, St, H])
+    return _fc(ctxv, H, name + ".out", w_spec=(MODEL_AXIS, None), cfg=cfg)
+
+
+def _decoder_layer(x, mem, cfg: TransformerConfig, self_bias, cross_bias,
+                   name):
+    import dataclasses
+
+    causal_cfg = dataclasses.replace(cfg, causal=True)
+    a = multi_head_attention(x, causal_cfg, self_bias, name=name + ".self")
+    if cfg.dropout:
+        a = L.dropout(a, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    x = L.layer_norm(L.elementwise_add(x, a), begin_norm_axis=2,
+                     name=name + ".ln1")
+    c = cross_attention(x, mem, cfg, cross_bias, name=name + ".cross")
+    if cfg.dropout:
+        c = L.dropout(c, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    x = L.layer_norm(L.elementwise_add(x, c), begin_norm_axis=2,
+                     name=name + ".ln2")
+    f = positionwise_ffn(x, cfg, name=name + ".ffn")
+    if cfg.dropout:
+        f = L.dropout(f, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    return L.layer_norm(L.elementwise_add(x, f), begin_norm_axis=2,
+                        name=name + ".ln3")
+
+
+def _embed_stream(ids, pos_ids, cfg, name, word_emb_name=None):
+    emb = L.embedding(ids, size=[cfg.vocab_size, cfg.hidden_size],
+                      param_attr=ParamAttr(name=word_emb_name or
+                                           name + ".word_emb"),
+                      dtype=cfg.dtype)
+    pos = L.embedding(pos_ids, size=[cfg.max_position, cfg.hidden_size],
+                      param_attr=ParamAttr(name=name + ".pos_emb"),
+                      dtype=cfg.dtype)
+    x = L.scale(emb, scale=cfg.hidden_size ** 0.5)
+    x = L.elementwise_add(x, pos)
+    if cfg.dropout:
+        x = L.dropout(x, dropout_prob=cfg.dropout,
+                      dropout_implementation="upscale_in_train")
+    return x
+
+
+def transformer_wmt(cfg: TransformerConfig, src_len: int = 128,
+                    tgt_len: int = 128, label_smooth_eps: float = 0.1):
+    """Training program for WMT translation: returns (avg_loss, feeds dict).
+
+    Feeds (all [B, len]): src_ids/src_pos int64, tgt_ids/tgt_pos int64 (the
+    shifted-right decoder input), tgt_label int64, tgt_weight float32 (0 on
+    padding). Label-smoothed cross entropy averaged over non-pad tokens —
+    the reference transformer book model's loss. Source and target share the
+    joint-BPE word embedding table.
+    """
+    src_ids = L.data(name="src_ids", shape=[src_len], dtype="int64")
+    src_pos = L.data(name="src_pos", shape=[src_len], dtype="int64")
+    tgt_ids = L.data(name="tgt_ids", shape=[tgt_len], dtype="int64")
+    tgt_pos = L.data(name="tgt_pos", shape=[tgt_len], dtype="int64")
+    tgt_label = L.data(name="tgt_label", shape=[tgt_len], dtype="int64")
+    tgt_weight = L.data(name="tgt_weight", shape=[tgt_len], dtype="float32")
+
+    mem = _embed_stream(src_ids, src_pos, cfg, "enc", word_emb_name="word_emb")
+    for i in range(cfg.num_layers):
+        mem = _encoder_layer(mem, cfg, None, name=f"enc.layer{i}")
+
+    x = _embed_stream(tgt_ids, tgt_pos, cfg, "dec", word_emb_name="word_emb")
+    for i in range(cfg.num_layers):
+        x = _decoder_layer(x, mem, cfg, None, None, name=f"dec.layer{i}")
+
+    logits = _fc(x, cfg.vocab_size, "proj", w_spec=(None, MODEL_AXIS),
+                 b_spec=(MODEL_AXIS,), cfg=cfg)        # [B,St,V]
+    if label_smooth_eps:
+        onehot = L.one_hot(tgt_label, cfg.vocab_size)  # [B,St,V]
+        soft = L.label_smooth(onehot, epsilon=label_smooth_eps)
+        loss = L.softmax_with_cross_entropy(logits, soft, soft_label=True)
+    else:
+        loss = L.softmax_with_cross_entropy(
+            logits, L.unsqueeze(tgt_label, axes=[2]))
+    loss = L.squeeze(loss, axes=[2])                   # [B,St]
+    weighted = L.elementwise_mul(loss, tgt_weight)
+    denom = L.elementwise_add(L.reduce_sum(tgt_weight), _const_eps())
+    avg_loss = L.elementwise_div(L.reduce_sum(weighted), denom)
+    feeds = {v.name: v for v in (src_ids, src_pos, tgt_ids, tgt_pos,
+                                 tgt_label, tgt_weight)}
+    return avg_loss, feeds
