@@ -272,11 +272,13 @@ def bench_deepfm(on_tpu: bool):
         # trained parameter before AND after the timed pass — exe.run
         # dispatch is async, so the clock must not stop with device work
         # still in flight (same discipline as the other benches)
+        drain = main_p.all_parameters()[-1].name
+        assert pt.global_scope().find_var(drain) is not None, drain
         exe.train_from_dataset(main_p, ds, print_period=10**9)
-        np.asarray(pt.global_scope().find_var("deep_out_w"))
+        np.asarray(pt.global_scope().find_var(drain))
         t0 = time.perf_counter()
         exe.train_from_dataset(main_p, ds, print_period=10**9)
-        np.asarray(pt.global_scope().find_var("deep_out_w"))
+        np.asarray(pt.global_scope().find_var(drain))
         dt = time.perf_counter() - t0
         (lv,) = exe.run(main_p, feed={
             "sparse_ids": rng.integers(0, vocab, (batch, n_fields)).astype(np.int64),

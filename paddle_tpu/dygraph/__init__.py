@@ -574,3 +574,11 @@ def _const_init(v):
     from ..initializer import Constant
 
     return Constant(v)
+
+
+# multi-process DP + disk checkpoints live in submodules (import after the
+# core so they can use Layer/VarBase/_dy_op)
+from .parallel import DataParallel  # noqa: E402,F401
+from .checkpoint import save_dygraph, load_dygraph  # noqa: E402,F401
+
+__all__ += ["DataParallel", "save_dygraph", "load_dygraph"]

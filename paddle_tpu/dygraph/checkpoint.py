@@ -1,0 +1,83 @@
+"""Dygraph checkpointing: save_dygraph / load_dygraph.
+
+Reference contract (/root/reference/python/paddle/fluid/dygraph/checkpoint.py):
+`save_dygraph(state_dict, model_path)` writes `model_path + ".pdparams"`
+(or ".pdopt" when the dict carries optimizer state), `load_dygraph(path)`
+returns `(param_dict, opt_dict_or_None)` accepting the bare prefix.
+
+Arrays are stored as a dict of numpy arrays (np.savez container renamed to
+the reference's extension) — framework-independent on disk, loadable without
+a device."""
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+_OPT_KEYS = ("LR_Scheduler", "global_step")
+
+
+def _is_opt_state(state_dict: dict) -> bool:
+    # reference save_dygraph picks ".pdopt" when the dict came from
+    # optimizer.state_dict() — detectable by its bookkeeping keys or by the
+    # exact accumulator-name suffix the optimizers generate
+    # ("<param>_moment1_0", "<param>_velocity_0", ...). A suffix match, not
+    # a substring one: a model parameter named "momentum_encoder.weight"
+    # must still save as .pdparams.
+    import re
+
+    acc = re.compile(
+        r"_(moment\d*|velocity|beta\d_pow_acc|pow_acc|mean_square|mean_grad|"
+        r"accumulator|squared|linear)_\d+$")
+    return any(k in state_dict for k in _OPT_KEYS) or any(
+        acc.search(str(k)) for k in state_dict)
+
+
+def save_dygraph(state_dict: dict, model_path: str):
+    """Persist a Layer.state_dict() (-> .pdparams) or optimizer state
+    (-> .pdopt). `model_path` is the extensionless prefix."""
+    if not model_path:
+        raise ValueError("model_path must be a non-empty path prefix")
+    base = os.path.basename(model_path)
+    if not base or base.startswith("."):
+        raise ValueError(
+            f"model_path '{model_path}' must end with a file prefix, not a "
+            "directory or hidden name")
+    arrays = {}
+    for k, v in state_dict.items():
+        arrays[k] = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+    suffix = ".pdopt" if _is_opt_state(state_dict) else ".pdparams"
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.savez(model_path + suffix + ".npz", **arrays)
+    os.replace(model_path + suffix + ".npz", model_path + suffix)
+
+
+def load_dygraph(model_path: str):
+    """Return (param_dict, opt_dict) for the prefix; either may be None if
+    the corresponding file is absent (reference checkpoint.py load_dygraph)."""
+    for ext in (".pdparams", ".pdopt"):
+        if model_path.endswith(ext):
+            model_path = model_path[: -len(ext)]
+            break
+    params = opt = None
+    ppath, opath = model_path + ".pdparams", model_path + ".pdopt"
+    if os.path.exists(ppath):
+        params = _load_npz(ppath)
+    if os.path.exists(opath):
+        opt = _load_npz(opath)
+    if params is None and opt is None:
+        raise ValueError(
+            f"no checkpoint found at '{model_path}' (.pdparams/.pdopt)")
+    return params, opt
+
+
+def _load_npz(path: str) -> dict:
+    if not zipfile.is_zipfile(path):
+        raise ValueError(f"'{path}' is not a dygraph checkpoint")
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}

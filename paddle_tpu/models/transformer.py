@@ -87,6 +87,35 @@ def _fc(x, size, prefix, w_spec=None, b_spec=None, act=None, cfg=None):
     return out
 
 
+def _attn_core(q, k, v, attn_bias, cfg: TransformerConfig, causal, dh):
+    """The attention block proper, [B,nh,Sq,dh] x [B,nh,Sk,dh] -> [B,nh,Sq,dh].
+
+    One fused-attention op boundary whenever semantics allow (no additive
+    bias, no attention-prob dropout): the op dispatches to the measured
+    winner per shape — XLA fusion at train sizes, Pallas for long context.
+    cfg.use_flash_attention forces an O(S)-memory kernel. Shared by self-
+    and cross-attention so the dispatch policy lives in exactly one place.
+    """
+    if attn_bias is None and not cfg.dropout:
+        return L.fused_attention(q, k, v, causal=causal, sm_scale=dh ** -0.5,
+                                 use_pallas=cfg.use_flash_attention)
+    scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+    if attn_bias is not None:
+        scores = L.elementwise_add(scores, attn_bias)
+    if causal:
+        # fused causal-mask+softmax op (probs directly)
+        helper = L.nn.LayerHelper("causal_softmax")
+        probs = helper.create_variable_for_type_inference(scores.dtype)
+        helper.append_op("softmax_mask_fuse_upper_triangle",
+                         {"X": [scores.name]}, {"Out": [probs.name]}, {})
+    else:
+        probs = L.softmax(scores)
+    if cfg.dropout:
+        probs = L.dropout(probs, dropout_prob=cfg.dropout,
+                          dropout_implementation="upscale_in_train")
+    return L.matmul(probs, v)
+
+
 def multi_head_attention(x, cfg: TransformerConfig, attn_bias=None, name="attn"):
     """Self-attention: fused QKV projection, [B,S,H] -> [B,S,H].
 
@@ -104,31 +133,7 @@ def multi_head_attention(x, cfg: TransformerConfig, attn_bias=None, name="attn")
     k = L.squeeze(L.slice(qkv, axes=[0], starts=[1], ends=[2]), axes=[0])
     v = L.squeeze(L.slice(qkv, axes=[0], starts=[2], ends=[3]), axes=[0])
 
-    # one fused-attention op boundary whenever semantics allow (no additive
-    # bias, no attention-prob dropout): the op dispatches to the measured
-    # winner per shape — XLA fusion at train sizes, Pallas for long context.
-    # cfg.use_flash_attention forces the custom Pallas kernel (O(S) memory).
-    use_fused = attn_bias is None and not cfg.dropout
-    if use_fused:
-        ctxv = L.fused_attention(q, k, v, causal=cfg.causal,
-                                 sm_scale=dh ** -0.5,
-                                 use_pallas=cfg.use_flash_attention)
-    else:
-        scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-        if attn_bias is not None:
-            scores = L.elementwise_add(scores, attn_bias)
-        if cfg.causal:
-            # fused causal-mask+softmax op (probs directly)
-            helper = L.nn.LayerHelper("causal_softmax")
-            probs = helper.create_variable_for_type_inference(scores.dtype)
-            helper.append_op("softmax_mask_fuse_upper_triangle",
-                             {"X": [scores.name]}, {"Out": [probs.name]}, {})
-        else:
-            probs = L.softmax(scores)
-        if cfg.dropout:
-            probs = L.dropout(probs, dropout_prob=cfg.dropout,
-                              dropout_implementation="upscale_in_train")
-        ctxv = L.matmul(probs, v)                 # [B,nh,S,dh]
+    ctxv = _attn_core(q, k, v, attn_bias, cfg, causal=cfg.causal, dh=dh)
     ctxv = L.transpose(ctxv, perm=[0, 2, 1, 3])
     ctxv = L.reshape(ctxv, shape=[0, S, H])
     out = _fc(ctxv, H, name + ".out", w_spec=(MODEL_AXIS, None), cfg=cfg)
@@ -262,18 +267,7 @@ def cross_attention(x, mem, cfg: TransformerConfig, attn_bias=None,
                      perm=[2, 0, 3, 1, 4])
     k = L.squeeze(L.slice(kv, axes=[0], starts=[0], ends=[1]), axes=[0])
     v = L.squeeze(L.slice(kv, axes=[0], starts=[1], ends=[2]), axes=[0])
-    if attn_bias is None and not cfg.dropout:
-        ctxv = L.fused_attention(q, k, v, causal=False, sm_scale=dh ** -0.5,
-                                 use_pallas=cfg.use_flash_attention)
-    else:
-        scores = L.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-        if attn_bias is not None:
-            scores = L.elementwise_add(scores, attn_bias)
-        probs = L.softmax(scores)
-        if cfg.dropout:
-            probs = L.dropout(probs, dropout_prob=cfg.dropout,
-                              dropout_implementation="upscale_in_train")
-        ctxv = L.matmul(probs, v)
+    ctxv = _attn_core(q, k, v, attn_bias, cfg, causal=False, dh=dh)
     ctxv = L.reshape(L.transpose(ctxv, perm=[0, 2, 1, 3]), shape=[0, St, H])
     return _fc(ctxv, H, name + ".out", w_spec=(MODEL_AXIS, None), cfg=cfg)
 
@@ -320,14 +314,18 @@ def _embed_stream(ids, pos_ids, cfg, name, word_emb_name=None):
 
 
 def transformer_wmt(cfg: TransformerConfig, src_len: int = 128,
-                    tgt_len: int = 128, label_smooth_eps: float = 0.1):
+                    tgt_len: int = 128, label_smooth_eps: float = 0.1,
+                    use_src_mask: bool = False):
     """Training program for WMT translation: returns (avg_loss, feeds dict).
 
     Feeds (all [B, len]): src_ids/src_pos int64, tgt_ids/tgt_pos int64 (the
     shifted-right decoder input), tgt_label int64, tgt_weight float32 (0 on
-    padding). Label-smoothed cross entropy averaged over non-pad tokens —
-    the reference transformer book model's loss. Source and target share the
-    joint-BPE word embedding table.
+    padding). With `use_src_mask` an extra src_mask [B, src_len] float32
+    (1=token, 0=pad) feed masks encoder self-attention AND decoder
+    cross-attention, so padded source positions cannot contaminate the
+    memory (tgt_weight only masks the loss). Label-smoothed cross entropy
+    averaged over non-pad tokens — the reference transformer book model's
+    loss. Source and target share the joint-BPE word embedding table.
     """
     src_ids = L.data(name="src_ids", shape=[src_len], dtype="int64")
     src_pos = L.data(name="src_pos", shape=[src_len], dtype="int64")
@@ -336,13 +334,23 @@ def transformer_wmt(cfg: TransformerConfig, src_len: int = 128,
     tgt_label = L.data(name="tgt_label", shape=[tgt_len], dtype="int64")
     tgt_weight = L.data(name="tgt_weight", shape=[tgt_len], dtype="float32")
 
+    src_bias = None
+    extra_feeds = []
+    if use_src_mask:
+        src_mask = L.data(name="src_mask", shape=[src_len], dtype="float32")
+        extra_feeds.append(src_mask)
+        # [B,S] 1/0 -> additive bias [B,1,1,S] (broadcasts over heads + query)
+        neg = L.scale(src_mask, scale=-1.0, bias=1.0)
+        neg = L.scale(neg, scale=-1e9)
+        src_bias = L.unsqueeze(L.unsqueeze(neg, axes=[1]), axes=[1])
+
     mem = _embed_stream(src_ids, src_pos, cfg, "enc", word_emb_name="word_emb")
     for i in range(cfg.num_layers):
-        mem = _encoder_layer(mem, cfg, None, name=f"enc.layer{i}")
+        mem = _encoder_layer(mem, cfg, src_bias, name=f"enc.layer{i}")
 
     x = _embed_stream(tgt_ids, tgt_pos, cfg, "dec", word_emb_name="word_emb")
     for i in range(cfg.num_layers):
-        x = _decoder_layer(x, mem, cfg, None, None, name=f"dec.layer{i}")
+        x = _decoder_layer(x, mem, cfg, None, src_bias, name=f"dec.layer{i}")
 
     logits = _fc(x, cfg.vocab_size, "proj", w_spec=(None, MODEL_AXIS),
                  b_spec=(MODEL_AXIS,), cfg=cfg)        # [B,St,V]
@@ -358,5 +366,5 @@ def transformer_wmt(cfg: TransformerConfig, src_len: int = 128,
     denom = L.elementwise_add(L.reduce_sum(tgt_weight), _const_eps())
     avg_loss = L.elementwise_div(L.reduce_sum(weighted), denom)
     feeds = {v.name: v for v in (src_ids, src_pos, tgt_ids, tgt_pos,
-                                 tgt_label, tgt_weight)}
+                                 tgt_label, tgt_weight, *extra_feeds)}
     return avg_loss, feeds

@@ -1,0 +1,70 @@
+"""Dygraph DataParallel runner (reference parallel_dygraph_mnist.py driven by
+TestDistBase): under the launcher each process trains its batch shard with
+scale_loss + apply_collective_grads; with one process it is the local
+baseline. usage: dist_dygraph.py OUT_NPZ"""
+import sys
+
+from paddle_tpu.distributed import init_parallel_env
+
+penv = init_parallel_env(backend="cpu", local_device_count=1)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import dygraph as dg  # noqa: E402
+from paddle_tpu.dygraph import _dy_op  # noqa: E402
+
+STEPS = 5
+FULL_BATCH = 32
+
+
+class Net(dg.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = dg.Linear(16, 32, act="relu")
+        self.fc2 = dg.Linear(32, 1)
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((FULL_BATCH, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 1)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def main():
+    out = sys.argv[1]
+    if penv.world_size > 1:
+        out = f"{out}.r{penv.rank}.npz"
+
+    with dg.guard(seed=11):
+        model = dg.DataParallel(Net())
+        sgd = pt.optimizer.SGD(0.1)
+        x, y = full_data()
+        shard = FULL_BATCH // penv.world_size
+        lo = penv.rank * shard
+        xs, ys = x[lo:lo + shard], y[lo:lo + shard]
+        for _ in range(STEPS):
+            pred = model(dg.to_variable(xs))
+            diff = _dy_op("elementwise_sub",
+                          {"X": [pred], "Y": [dg.to_variable(ys)]})["Out"]
+            loss = _dy_op("mean", {"X": [_dy_op("square",
+                                               {"X": [diff]})["Out"]]})["Out"]
+            loss = model.scale_loss(loss)
+            loss.backward()
+            model.apply_collective_grads()
+            sgd.minimize(loss, parameter_list=model.parameters())
+            for p in model.parameters():
+                p.clear_gradient()
+
+        vals = {f"p{i}": np.asarray(p.numpy())
+                for i, p in enumerate(model.parameters())}
+        vals["__last_loss__"] = np.asarray(loss.numpy())
+        np.savez(out, **vals)
+
+
+if __name__ == "__main__":
+    main()

@@ -197,3 +197,42 @@ def test_transformer_wmt_decoder_is_causal():
         mod["tgt_ids"] = tgt2
         (l1,) = exe.run(main, feed=mod, fetch_list=[avg_loss])
     np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+
+
+def test_transformer_wmt_src_mask_blocks_padding():
+    """With use_src_mask, changing MASKED source tokens must not change the
+    loss (encoder self-attn and decoder cross-attn both honor the mask)."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=32, hidden_size=16, num_layers=1, num_heads=2,
+        ffn_size=32, max_position=16, dropout=0.0, use_tp=False)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            avg_loss, feeds = transformer.transformer_wmt(
+                cfg, src_len=4, tgt_len=4, label_smooth_eps=0.0,
+                use_src_mask=True)
+    assert "src_mask" in feeds
+    rng = np.random.default_rng(0)
+    B = 2
+    base = {
+        "src_ids": rng.integers(0, 32, (B, 4)).astype(np.int64),
+        "src_pos": np.tile(np.arange(4, dtype=np.int64), (B, 1)),
+        "tgt_ids": rng.integers(0, 32, (B, 4)).astype(np.int64),
+        "tgt_pos": np.tile(np.arange(4, dtype=np.int64), (B, 1)),
+        "tgt_label": rng.integers(0, 32, (B, 4)).astype(np.int64),
+        "tgt_weight": np.ones((B, 4), np.float32),
+        "src_mask": np.array([[1, 1, 0, 0]] * B, np.float32),
+    }
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        (l0,) = exe.run(main, feed=base, fetch_list=[avg_loss])
+        mod = dict(base)
+        s2 = base["src_ids"].copy()
+        s2[:, 2:] = (s2[:, 2:] + 5) % 32
+        mod["src_ids"] = s2
+        (l1,) = exe.run(main, feed=mod, fetch_list=[avg_loss])
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
