@@ -1,0 +1,220 @@
+"""Async-mode Communicator: per-gradient send queues, merge-before-send,
+independent recv thread.
+
+TPU-native redesign of the reference async stack
+(/root/reference/paddle/fluid/operators/distributed/communicator.h:162
+AsyncCommunicator: send_varname_to_queue_ + per-grad SendThread merging up to
+max_merge_var_num grads before one RPC, RecvThread pulling parameters after
+min_send_grad_num_before_recv sends; knobs exported at
+/root/reference/python/paddle/fluid/__init__.py:65-71).
+
+Trainer flow in async mode: the program's `send` ops ENQUEUE the gradient
+here and return immediately (no barrier ops exist); per-grad worker threads
+drain the queue, merge (dense: mean, sparse: row-concat — the server's row
+update handles duplicates), and push to the assigned pserver(s), where each
+send applies one optimizer step at arrival time (ps_rpc._apply_one). A
+single recv thread refreshes every parameter into the trainer scope at a
+fixed cadence once enough grads have gone out.
+
+Knobs ride the flags registry (FLAGS_communicator_*), same names as the
+reference.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import flags
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    """One per trainer process (reference Communicator::GetInstance)."""
+
+    _singleton: "Communicator | None" = None
+
+    def __init__(self, send_ctx: dict, recv_ctx: dict, client, scope):
+        """send_ctx: {grad_name: {"epmap": [...], "sections": [...]}};
+        recv_ctx: {param_name: {"epmap": [...], "sections": [...]}};
+        client: PSClient; scope: the trainer Scope recv writes into."""
+        self.send_ctx = send_ctx
+        self.recv_ctx = recv_ctx
+        self.client = client
+        self.scope = scope
+        self.max_merge = flags.get_flag("communicator_max_merge_var_num")
+        self.queue_size = flags.get_flag("communicator_send_queue_size")
+        self.wait_times = flags.get_flag("communicator_send_wait_times")
+        self.min_send_before_recv = flags.get_flag(
+            "communicator_min_send_grad_num_before_recv")
+        self.independent_recv = flags.get_flag(
+            "communicator_independent_recv_thread")
+        self._queues: dict[str, queue.Queue] = {
+            n: queue.Queue(maxsize=self.queue_size) for n in send_ctx}
+        self._threads: list[threading.Thread] = []
+        self._running = False
+        self._grads_sent = 0
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    def get_instance(cls) -> "Communicator | None":
+        return cls._singleton
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        Communicator._singleton = self
+        for name in self.send_ctx:
+            t = threading.Thread(target=self._send_loop, args=(name,),
+                                 daemon=True, name=f"comm-send-{name}")
+            t.start()
+            self._threads.append(t)
+        if self.independent_recv and self.recv_ctx:
+            t = threading.Thread(target=self._recv_loop, daemon=True,
+                                 name="comm-recv")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        """Flush every queue, then stop the threads (reference
+        Communicator::Stop waits for send queues to drain)."""
+        if not self._running:
+            return
+        for q in self._queues.values():
+            q.join()  # all enqueued grads merged + sent
+        self._running = False
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
+        if Communicator._singleton is self:
+            Communicator._singleton = None
+        err = getattr(self, "_recv_error", None)
+        if err is not None:
+            raise RuntimeError(
+                f"Communicator recv thread failed: {err}") from err
+        # one final parameter pull so the trainer scope holds the servers'
+        # latest state when training ends
+        self._recv_all()
+
+    @property
+    def is_running(self):
+        return self._running
+
+    # -- send side -----------------------------------------------------------
+    def push(self, name: str, value) -> None:
+        """Called by the `send` op. Blocks when the queue is full
+        (backpressure — reference send_queue_size contract); surfaces a
+        send-thread failure instead of blocking forever behind it."""
+        q = self._queues[name]
+        while True:
+            err = getattr(self, "_send_error", None)
+            if err is not None:
+                raise RuntimeError(
+                    f"Communicator send thread failed: {err}") from err
+            try:
+                q.put(value, timeout=1.0)
+                return
+            except queue.Full:
+                continue
+
+    def _send_loop(self, name: str):
+        q = self._queues[name]
+        ctx = self.send_ctx[name]
+        while self._running or not q.empty():
+            try:
+                first = q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            # merge-before-send: wait up to wait_times short intervals for
+            # more grads, cap at max_merge_var_num (reference SendThread)
+            waits = 0
+            while len(batch) < self.max_merge and waits < self.wait_times:
+                try:
+                    batch.append(q.get_nowait())
+                except queue.Empty:
+                    waits += 1
+                    time.sleep(0.002)
+            try:
+                self._send_merged(name, ctx, batch)
+                self._send_error = None  # transient failures don't poison
+            except Exception as e:
+                # a dead send thread would silently jam the queue and block
+                # every future push() — survive, drop the batch, record the
+                # error so push() can surface it (cleared on next success)
+                self._send_error = e
+            finally:
+                for _ in batch:
+                    q.task_done()
+            with self._lock:
+                self._grads_sent += len(batch)
+            if not self.independent_recv and self.recv_ctx:
+                # non-independent mode (reference AsyncCommunicator with
+                # the flag off): recv inline with send progress
+                with self._lock:
+                    ready = self._grads_sent >= self.min_send_before_recv
+                    if ready:
+                        self._grads_sent = 0
+                if ready:
+                    self._recv_all()
+
+    def _send_merged(self, name, ctx, batch):
+        from .ps_rpc import send_sections
+
+        epmap = ctx["epmap"]
+        sections = ctx.get("sections") or []
+        sparse = [v for v in batch if hasattr(v, "rows")]
+        if sparse:
+            from ..core.selected_rows import SelectedRows
+
+            rows = np.concatenate([np.asarray(v.rows) for v in sparse])
+            vals = np.concatenate([np.asarray(v.values) for v in sparse])
+            self.client.send_var(epmap[0], name,
+                                 SelectedRows(rows, vals, sparse[0].height))
+            return
+        acc = np.asarray(batch[0], dtype=np.float32).copy()
+        for v in batch[1:]:
+            acc += np.asarray(v)
+        acc /= len(batch)  # mean of merged grads (reference MergeVars)
+        send_sections(self.client, name, acc, epmap, sections)
+
+    # -- recv side -----------------------------------------------------------
+    def _recv_loop(self):
+        """Pull params every `min_send_grad_num_before_recv` sent grads —
+        recv cadence tracks training PROGRESS, not wall-clock (reference
+        RecvThread: grad_num_ >= min -> RecvAll, counter reset), so a fast
+        trainer can't race ahead on stale parameters."""
+        while self._running:
+            with self._lock:
+                ready = self._grads_sent >= self.min_send_before_recv
+                if ready:
+                    self._grads_sent = 0
+            if ready:
+                try:
+                    self._recv_all()
+                except Exception as e:
+                    # a dead recv thread = the whole run silently trains on
+                    # stale params; record so stop() re-raises
+                    self._recv_error = e
+                    return
+            else:
+                time.sleep(0.005)
+
+    def _recv_all(self):
+        from .ps_rpc import fetch_sections
+
+        for pname, ctx in self.recv_ctx.items():
+            try:
+                val = fetch_sections(self.client, pname, ctx["epmap"],
+                                     ctx.get("sections") or [])
+            except (ConnectionError, EOFError, OSError):
+                return  # server shutting down: keep the last-known params
+            # a server-side "err" reply (RuntimeError from PSClient._call —
+            # e.g. a wrong name in recv_ctx) propagates: swallowing it would
+            # silently train the whole run on initial parameters
+            self.scope.set_var(pname, val)

@@ -39,6 +39,32 @@ def _parse_ep(ep: str):
     return (host, int(port))
 
 
+# -- wire contract for row-sliced variables ----------------------------------
+# One definition of the "name.block{j}" section protocol shared by the send/
+# recv ops AND the async Communicator — the slicing math must never drift
+# between the three users (reference parameter_send.cc / parameter_recv.cc).
+
+
+def send_sections(client, name: str, arr, epmap, sections) -> None:
+    """Send a dense var, row-split per `sections` across `epmap` (whole var
+    to epmap[0] when unsliced)."""
+    if len(sections) <= 1:
+        client.send_var(epmap[0], name, arr)
+        return
+    offs = np.cumsum([0] + list(sections[:-1]))
+    for j, (ep, off, rows) in enumerate(zip(epmap, offs, sections)):
+        client.send_var(ep, f"{name}.block{j}", arr[off:off + rows])
+
+
+def fetch_sections(client, name: str, epmap, sections) -> np.ndarray:
+    """Inverse of send_sections: pull + row-concat a var's blocks."""
+    if len(sections) <= 1:
+        return client.get_var(epmap[0], name)
+    parts = [client.get_var(ep, f"{name}.block{j}")
+             for j, ep in enumerate(epmap)]
+    return np.concatenate(parts, axis=0)
+
+
 class PSClient:
     """One connection per pserver endpoint; thread-safe via a lock per conn."""
 
@@ -49,6 +75,11 @@ class PSClient:
         self.trainer_id = trainer_id
         self._conns = {}
         self._locks = {}
+        # guards first-connection creation: the async Communicator calls in
+        # from N send threads + the recv thread concurrently, and an
+        # unsynchronized check-then-create could hand two threads the same
+        # Connection under different locks
+        self._create_lock = threading.Lock()
 
     @classmethod
     def get(cls, endpoints, trainer_id) -> "PSClient":
@@ -61,18 +92,20 @@ class PSClient:
     def _conn(self, ep: str):
         import time
 
-        if ep not in self._conns:
-            deadline = time.monotonic() + 30.0
-            while True:
-                try:
-                    self._conns[ep] = Client(_parse_ep(ep), authkey=_authkey())
-                    break
-                except (ConnectionRefusedError, FileNotFoundError):
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(0.2)  # server may still be starting
-            self._locks[ep] = threading.Lock()
-        return self._conns[ep], self._locks[ep]
+        with self._create_lock:
+            if ep not in self._conns:
+                deadline = time.monotonic() + 30.0
+                while True:
+                    try:
+                        conn = Client(_parse_ep(ep), authkey=_authkey())
+                        break
+                    except (ConnectionRefusedError, FileNotFoundError):
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.2)  # server may still be starting
+                self._locks[ep] = threading.Lock()
+                self._conns[ep] = conn
+            return self._conns[ep], self._locks[ep]
 
     def _call(self, ep: str, msg: dict) -> Any:
         conn, lock = self._conn(ep)
@@ -283,7 +316,42 @@ class PServerRuntime:
         except OSError:
             pass
 
+    def _warm_optimize_programs(self):
+        """Pre-compile each dense block's optimize program before accepting
+        traffic: the first real send otherwise pays the whole-block jit
+        compile while holding the server lock, stalling every trainer for
+        seconds (observed: an async trainer finishes its run before the
+        first update lands). A zero-grad run hits the same compile cache as
+        real sends (same feed shape); the scope snapshot/restore makes it
+        side-effect-free for any optimizer state."""
+        from ..executor import scope_guard
+
+        todo = [s for s in self.blocks.values()
+                if not s.get("sparse")
+                and self.scope.find_var(s["param"]) is not None]
+        if not todo:
+            return
+        # ONE snapshot around all warmups, as HOST COPIES: the executor
+        # donates state buffers into each run, so restoring the original
+        # jax.Array references would put deleted buffers back into the scope
+        snapshot = {}
+        for k, v in self.scope._vars.items():
+            try:
+                snapshot[k] = np.array(np.asarray(v))
+            except Exception:
+                snapshot[k] = v  # non-array state: not donate-able
+        try:
+            for spec in todo:
+                pv = self.scope.find_var(spec["param"])
+                zero = np.zeros(np.asarray(pv).shape, np.float32)
+                with scope_guard(self.scope):
+                    self.exe.run(spec["optimize_program"],
+                                 feed={spec["grad"]: zero})
+        finally:
+            self.scope._vars = snapshot
+
     def serve(self):
+        self._warm_optimize_programs()
         listener = Listener(_parse_ep(self.endpoint), authkey=_authkey())
         threads = []
         while not self._shutdown.is_set():

@@ -68,3 +68,15 @@ _define("cpu_deterministic", False,
         "reference flags.cc:98)")
 _define("profiler_dir", "/tmp/paddle_tpu_profile",
         "default trace output directory for profiler.profiler()")
+# async Communicator knobs (reference python/paddle/fluid/__init__.py:65-71)
+_define("communicator_max_merge_var_num", 20,
+        "max gradients merged into one send (reference "
+        "communicator_max_merge_var_num)")
+_define("communicator_send_queue_size", 20,
+        "per-gradient send queue capacity; push blocks when full")
+_define("communicator_independent_recv_thread", True,
+        "run the parameter recv thread independently of sends")
+_define("communicator_min_send_grad_num_before_recv", 20,
+        "grads sent before the recv thread starts pulling params")
+_define("communicator_send_wait_times", 5,
+        "short waits the send thread spends collecting grads to merge")

@@ -126,11 +126,30 @@ class ParameterServerFleet:
 
     # -- worker lifecycle ----------------------------------------------------
     def init_worker(self):
-        pass  # connections are lazy (PSClient.get on first send/recv)
+        """Sync mode: connections are lazy (PSClient.get on first send).
+        Async mode: build + start the Communicator (reference fleet
+        init_worker -> communicator init/start)."""
+        t = self._transpiler
+        if t is None or t.sync_mode:
+            return
+        from ...distributed.communicator import Communicator
+        from ...distributed.ps_rpc import PSClient
+        from ...executor import global_scope
+
+        send_ctx, recv_ctx = t.get_communicator_context()
+        client = PSClient.get(tuple(self.server_endpoints),
+                              self.worker_index())
+        self._communicator = Communicator(send_ctx, recv_ctx, client,
+                                          global_scope())
+        self._communicator.start()
 
     def stop_worker(self):
         from ...executor import Executor
 
+        comm = getattr(self, "_communicator", None)
+        if comm is not None:
+            comm.stop()  # drain send queues + final param pull
+            self._communicator = None
         Executor().close()  # send_complete to every pserver
 
 
@@ -159,7 +178,8 @@ class TranspilerOptimizer:
             program=f._origin_main,
             pservers=",".join(f.server_endpoints),
             trainers=f.worker_num(),
-            sync_mode=True,
+            sync_mode=getattr(self._config, "sync_mode", True)
+            if self._config is not None else True,
             startup_program=f._origin_startup,
         )
         f._transpiler = t

@@ -27,23 +27,29 @@ def _client(ctx: ExecContext):
 @register_op("send", grad="none", host=True)
 def send(ctx: ExecContext):
     """inputs X: vars to send; attrs: epmap (endpoint per section), sections
-    (row counts per section, empty = whole var), endpoints, trainer_id."""
+    (row counts per section, empty = whole var), endpoints, trainer_id.
+
+    Async mode: when a Communicator is running and owns this gradient, the
+    send ENQUEUES (merge-before-send + recv thread take over — reference
+    send_op.cc routing through Communicator::GetInstance)."""
+    from ..distributed.communicator import Communicator
+
+    comm = Communicator.get_instance()
     client = _client(ctx)
     epmap = list(ctx.attr("epmap", []))
     sections = list(ctx.attr("sections", []))
     for name, val in zip(ctx.op.inputs.get("X", []), ctx.inputs("X")):
         if val is None:
             continue
+        if comm is not None and comm.is_running and name in comm.send_ctx:
+            comm.push(name, val)
+            continue
         if hasattr(val, "rows"):  # SelectedRows: whole-table to one endpoint
             client.send_var(epmap[0], name, val)
             continue
-        if len(sections) <= 1:
-            client.send_var(epmap[0], name, np.asarray(val))
-        else:
-            arr = np.asarray(val)
-            offs = np.cumsum([0] + sections[:-1])
-            for j, (ep, off, rows) in enumerate(zip(epmap, offs, sections)):
-                client.send_var(ep, f"{name}.block{j}", arr[off:off + rows])
+        from ..distributed.ps_rpc import send_sections
+
+        send_sections(client, name, np.asarray(val), epmap, sections)
     return {}
 
 
@@ -63,17 +69,13 @@ def fetch_barrier(ctx: ExecContext):
 def recv(ctx: ExecContext):
     """outputs Out: vars to fill; attrs as `send`. Sliced vars concat by row
     (reference recv + concat pattern, distribute_transpiler.py get_trainer_program)."""
+    from ..distributed.ps_rpc import fetch_sections
+
     client = _client(ctx)
     epmap = list(ctx.attr("epmap", []))
     sections = list(ctx.attr("sections", []))
-    outs = []
-    for name in ctx.op.outputs.get("Out", []):
-        if len(sections) <= 1:
-            outs.append(client.get_var(epmap[0], name))
-        else:
-            parts = [client.get_var(ep, f"{name}.block{j}")
-                     for j, ep in enumerate(epmap)]
-            outs.append(np.concatenate(parts, axis=0))
+    outs = [fetch_sections(client, name, epmap, sections)
+            for name in ctx.op.outputs.get("Out", [])]
     return {"Out": outs}
 
 

@@ -41,6 +41,10 @@ class DistributeTranspilerConfig:
     slice_var_up = True
     min_block_size = 8192
     split_method = RoundRobin
+    # async parameter server (reference fleet DistributedStrategy sync_mode):
+    # False = sends apply immediately server-side, Communicator merges +
+    # recv-threads client-side, no barriers
+    sync_mode = True
 
 
 class VarBlock:
@@ -263,13 +267,28 @@ class DistributeTranspiler:
             )
         if self.sync_mode:
             block.append_op("send_barrier", {}, {}, dict(common))
-        for pb in self.param_blocks:
-            block.append_op(
-                "recv", {}, {"Out": [pb["param"]]},
-                {"epmap": pb["eps"], "sections": pb["sections"], **common},
-            )
-        if self.sync_mode:
+            for pb in self.param_blocks:
+                block.append_op(
+                    "recv", {}, {"Out": [pb["param"]]},
+                    {"epmap": pb["eps"], "sections": pb["sections"], **common},
+                )
             block.append_op("fetch_barrier", {}, {}, dict(common))
+        # async mode: NO recv/barrier ops — the Communicator's independent
+        # recv thread refreshes parameters (reference async trainer program,
+        # communicator.h:162; recv ops would re-introduce a sync round-trip
+        # per step)
 
     def get_trainer_program(self, wait_port=True) -> Program:
         return self.origin_program
+
+    def get_communicator_context(self):
+        """(send_ctx, recv_ctx) for the async Communicator: per-gradient and
+        per-parameter endpoint/section maps (reference
+        communicator.py Communicator(program, ...) extraction)."""
+        send_ctx, recv_ctx = {}, {}
+        for pb in self.param_blocks:
+            send_ctx[pb["grad"]] = {"epmap": pb["eps"],
+                                    "sections": pb["sections"]}
+            recv_ctx[pb["param"]] = {"epmap": pb["eps"],
+                                     "sections": pb["sections"]}
+        return send_ctx, recv_ctx

@@ -1,0 +1,169 @@
+"""Communicator unit semantics (reference communicator.h:162): per-grad
+queues, merge-N-before-send (dense mean / sparse row-concat), progress-gated
+recv, error surfacing. A fake client isolates the logic from networking."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu.distributed.communicator import Communicator
+
+
+class FakeClient:
+    def __init__(self):
+        self.sent = []          # (ep, name, value)
+        self.params = {}        # name -> value served to get_var
+        self.lock = threading.Lock()
+
+    def send_var(self, ep, name, value):
+        with self.lock:
+            self.sent.append((ep, name, value))
+
+    def get_var(self, ep, name):
+        with self.lock:
+            return self.params[name]
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_merge_before_send_dense_mean():
+    """N queued dense grads collapse into ONE send carrying their mean."""
+    client = FakeClient()
+    comm = Communicator({"g": {"epmap": ["ep0"], "sections": []}}, {},
+                        client, pt.Scope())
+    # enqueue BEFORE starting so the send thread sees a full queue at once
+    for i in range(4):
+        comm._queues["g"].put(np.full((3,), float(i), np.float32))
+    comm.start()
+    try:
+        assert _wait(lambda: len(client.sent) >= 1)
+        time.sleep(0.1)  # no extra sends must trickle out
+        assert len(client.sent) == 1, client.sent
+        ep, name, val = client.sent[0]
+        assert (ep, name) == ("ep0", "g")
+        np.testing.assert_allclose(val, np.full((3,), 1.5))  # mean(0..3)
+    finally:
+        comm.stop()
+
+
+def test_merge_cap_respects_max_merge_var_num():
+    old = flags.get_flag("communicator_max_merge_var_num")
+    flags.set_flags({"communicator_max_merge_var_num": 2})
+    try:
+        client = FakeClient()
+        comm = Communicator({"g": {"epmap": ["ep0"], "sections": []}}, {},
+                            client, pt.Scope())
+        for i in range(4):
+            comm._queues["g"].put(np.full((2,), float(i), np.float32))
+        comm.start()
+        try:
+            assert _wait(lambda: len(client.sent) >= 2)
+            time.sleep(0.1)
+            assert len(client.sent) == 2  # 4 grads / cap 2
+            np.testing.assert_allclose(client.sent[0][2], 0.5)  # mean(0,1)
+            np.testing.assert_allclose(client.sent[1][2], 2.5)  # mean(2,3)
+        finally:
+            comm.stop()
+    finally:
+        flags.set_flags({"communicator_max_merge_var_num": old})
+
+
+def test_merge_sparse_concatenates_rows():
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    client = FakeClient()
+    comm = Communicator({"emb@GRAD": {"epmap": ["ep0"], "sections": []}}, {},
+                        client, pt.Scope())
+    comm._queues["emb@GRAD"].put(
+        SelectedRows(np.array([0, 2]), np.ones((2, 4), np.float32), 10))
+    comm._queues["emb@GRAD"].put(
+        SelectedRows(np.array([1]), np.full((1, 4), 3.0, np.float32), 10))
+    comm.start()
+    try:
+        assert _wait(lambda: len(client.sent) >= 1)
+        _, _, sr = client.sent[0]
+        assert hasattr(sr, "rows")
+        np.testing.assert_array_equal(np.asarray(sr.rows), [0, 2, 1])
+        assert np.asarray(sr.values).shape == (3, 4)
+    finally:
+        comm.stop()
+
+
+def test_sectioned_send_slices_rows():
+    client = FakeClient()
+    comm = Communicator(
+        {"g": {"epmap": ["ep0", "ep1"], "sections": [2, 3]}}, {},
+        client, pt.Scope())
+    comm._queues["g"].put(np.arange(5, dtype=np.float32))
+    comm.start()
+    try:
+        assert _wait(lambda: len(client.sent) >= 2)
+        by_name = {n: (ep, v) for ep, n, v in client.sent}
+        np.testing.assert_allclose(by_name["g.block0"][1], [0, 1])
+        np.testing.assert_allclose(by_name["g.block1"][1], [2, 3, 4])
+        assert by_name["g.block0"][0] == "ep0"
+        assert by_name["g.block1"][0] == "ep1"
+    finally:
+        comm.stop()
+
+
+def test_recv_gated_on_send_progress():
+    """No params are pulled before min_send_grad_num_before_recv grads went
+    out; after the threshold the scope refreshes."""
+    old = flags.get_flag("communicator_min_send_grad_num_before_recv")
+    flags.set_flags({"communicator_min_send_grad_num_before_recv": 3})
+    try:
+        client = FakeClient()
+        client.params["w"] = np.full((2,), 7.0, np.float32)
+        scope = pt.Scope()
+        scope.set_var("w", np.zeros((2,), np.float32))
+        comm = Communicator({"g": {"epmap": ["ep0"], "sections": []}},
+                            {"w": {"epmap": ["ep0"], "sections": []}},
+                            client, scope)
+        comm.start()
+        try:
+            comm.push("g", np.zeros((2,), np.float32))
+            time.sleep(0.15)
+            np.testing.assert_allclose(np.asarray(scope.find_var("w")), 0.0)
+            for _ in range(4):
+                comm.push("g", np.zeros((2,), np.float32))
+            assert _wait(lambda: float(np.asarray(
+                scope.find_var("w"))[0]) == 7.0)
+        finally:
+            comm.stop()
+    finally:
+        flags.set_flags(
+            {"communicator_min_send_grad_num_before_recv": old})
+
+
+def test_push_surfaces_send_thread_failure():
+    class Exploding(FakeClient):
+        def send_var(self, ep, name, value):
+            raise ConnectionError("server gone")
+
+    old = flags.get_flag("communicator_send_queue_size")
+    flags.set_flags({"communicator_send_queue_size": 1})
+    try:
+        comm = Communicator({"g": {"epmap": ["ep0"], "sections": []}}, {},
+                            Exploding(), pt.Scope())
+        comm.start()
+        try:
+            with pytest.raises(RuntimeError, match="send thread failed"):
+                for _ in range(50):
+                    comm.push("g", np.zeros((2,), np.float32))
+                    time.sleep(0.01)
+        finally:
+            comm._send_error = None
+            comm.stop()
+    finally:
+        flags.set_flags({"communicator_send_queue_size": old})
