@@ -97,6 +97,13 @@ class Communicator:
         if err is not None:
             raise RuntimeError(
                 f"Communicator recv thread failed: {err}") from err
+        err = getattr(self, "_send_error", None)
+        if err is not None:
+            # a failure on the run's FINAL batches has no later push() to
+            # surface through — the tail gradients were lost
+            raise RuntimeError(
+                f"Communicator send thread failed (tail gradients "
+                f"dropped): {err}") from err
         # one final parameter pull so the trainer scope holds the servers'
         # latest state when training ends
         self._recv_all()
@@ -164,18 +171,21 @@ class Communicator:
                     self._recv_all()
 
     def _send_merged(self, name, ctx, batch):
-        from .ps_rpc import send_sections
+        from .ps_rpc import send_sections, send_sparse_sections
 
         epmap = ctx["epmap"]
         sections = ctx.get("sections") or []
+        begins = ctx.get("begins") or [0]
         sparse = [v for v in batch if hasattr(v, "rows")]
         if sparse:
             from ..core.selected_rows import SelectedRows
 
             rows = np.concatenate([np.asarray(v.rows) for v in sparse])
             vals = np.concatenate([np.asarray(v.values) for v in sparse])
-            self.client.send_var(epmap[0], name,
-                                 SelectedRows(rows, vals, sparse[0].height))
+            send_sparse_sections(
+                self.client, name,
+                SelectedRows(rows, vals, sparse[0].height),
+                epmap, begins, sections)
             return
         acc = np.asarray(batch[0], dtype=np.float32).copy()
         for v in batch[1:]:

@@ -46,9 +46,10 @@ def _parse_ep(ep: str):
 
 
 def send_sections(client, name: str, arr, epmap, sections) -> None:
-    """Send a dense var, row-split per `sections` across `epmap` (whole var
-    to epmap[0] when unsliced)."""
-    if len(sections) <= 1:
+    """Send a dense var, row-split per `sections` across `epmap`. EMPTY
+    sections = unsliced whole var under its bare name; NON-empty (even a
+    single block) = the server registered "name.block{j}" wire names."""
+    if not sections:
         client.send_var(epmap[0], name, arr)
         return
     offs = np.cumsum([0] + list(sections[:-1]))
@@ -58,11 +59,31 @@ def send_sections(client, name: str, arr, epmap, sections) -> None:
 
 def fetch_sections(client, name: str, epmap, sections) -> np.ndarray:
     """Inverse of send_sections: pull + row-concat a var's blocks."""
-    if len(sections) <= 1:
+    if not sections:
         return client.get_var(epmap[0], name)
     parts = [client.get_var(ep, f"{name}.block{j}")
              for j, ep in enumerate(epmap)]
     return np.concatenate(parts, axis=0)
+
+
+def send_sparse_sections(client, name: str, sr, epmap, begins,
+                         sections) -> None:
+    """Route a SelectedRows grad to its row-owning servers with slice-LOCAL
+    indices (reference split_ids + parameter_send.cc SelectedRows path).
+    Empty sections = whole table on epmap[0], global rows as-is."""
+    from ..core.selected_rows import SelectedRows
+
+    if not sections:
+        client.send_var(epmap[0], name, sr)
+        return
+    rows = np.asarray(sr.rows)
+    vals = np.asarray(sr.values)
+    for j, (ep, b, s) in enumerate(zip(epmap, begins, sections)):
+        mask = (rows >= b) & (rows < b + s)
+        if not mask.any():
+            continue
+        client.send_var(ep, f"{name}.block{j}",
+                        SelectedRows(rows[mask] - b, vals[mask], s))
 
 
 class PSClient:
@@ -92,20 +113,24 @@ class PSClient:
     def _conn(self, ep: str):
         import time
 
+        # the global lock only guards per-endpoint lock creation; the
+        # (possibly 30s) connect-retry runs under the ENDPOINT's lock so one
+        # unreachable server cannot stall RPCs to healthy ones
         with self._create_lock:
+            lock = self._locks.setdefault(ep, threading.Lock())
+        with lock:
             if ep not in self._conns:
                 deadline = time.monotonic() + 30.0
                 while True:
                     try:
-                        conn = Client(_parse_ep(ep), authkey=_authkey())
+                        self._conns[ep] = Client(_parse_ep(ep),
+                                                 authkey=_authkey())
                         break
                     except (ConnectionRefusedError, FileNotFoundError):
                         if time.monotonic() > deadline:
                             raise
                         time.sleep(0.2)  # server may still be starting
-                self._locks[ep] = threading.Lock()
-                self._conns[ep] = conn
-            return self._conns[ep], self._locks[ep]
+        return self._conns[ep], lock
 
     def _call(self, ep: str, msg: dict) -> Any:
         conn, lock = self._conn(ep)
@@ -128,6 +153,13 @@ class PSClient:
 
     def get_var(self, ep: str, name: str) -> np.ndarray:
         return self._call(ep, {"op": "get", "name": name})
+
+    def prefetch(self, ep: str, name: str, ids) -> np.ndarray:
+        """Fetch only the given (slice-local) rows of a server-resident
+        table (reference RPCClient::AsyncPrefetchVar rpc_client.h:62 +
+        RequestPrefetchHandler) — the whole table never travels."""
+        return self._call(ep, {"op": "prefetch", "name": name,
+                               "ids": np.asarray(ids, np.int64)})
 
     def send_barrier(self) -> None:
         """Blocks until the server has aggregated + applied this round."""
@@ -244,11 +276,17 @@ class PServerRuntime:
         return self.n_trainers - len(self._completed)
 
     def _run_round(self):
+        # scale by the ACTIVE trainer count, not by how many posted this
+        # grad: a row-sharded sparse table legitimately gets rows from a
+        # subset of trainers in a round, but the sync average is still over
+        # all of them (dense grads always arrive from everyone, so the two
+        # counts coincide there)
+        n_active = max(self._active_trainers(), 1)
         for grad_name, buf in list(self._grad_buf.items()):
             vals = [buf[t] for t in sorted(buf)]
             if not vals:
                 continue
-            self._apply_update(grad_name, vals, scale=1.0 / max(len(vals), 1))
+            self._apply_update(grad_name, vals, scale=1.0 / n_active)
             self._grad_buf[grad_name] = {}
         self._step += 1
 
@@ -301,6 +339,23 @@ class PServerRuntime:
         if v is None:
             raise KeyError(f"pserver has no var '{msg['name']}'")
         return np.asarray(v)
+
+    def _handle_prefetch(self, msg):
+        """Row-gather from a table slice (reference
+        RequestPrefetchHandler::Handle running the table's lookup block).
+        ids are slice-LOCAL (the trainer's prefetch op already subtracted
+        the block's row offset)."""
+        with self._lock:
+            v = self.scope.find_var(msg["name"])
+            if v is None:
+                raise KeyError(f"pserver has no table '{msg['name']}'")
+            table = np.asarray(v)
+            ids = np.asarray(msg["ids"], np.int64)
+            if ids.size and (ids.min() < 0 or ids.max() >= table.shape[0]):
+                raise IndexError(
+                    f"prefetch ids out of range for '{msg['name']}' "
+                    f"[0, {table.shape[0]}): min={ids.min()} max={ids.max()}")
+            return table[ids]
 
     # -- event loop ----------------------------------------------------------
     def _signal_shutdown(self):
@@ -386,6 +441,8 @@ class PServerRuntime:
                     conn.send(("ok", self._handle_send(msg)))
                 elif op == "get":
                     conn.send(("ok", self._handle_get(msg)))
+                elif op == "prefetch":
+                    conn.send(("ok", self._handle_prefetch(msg)))
                 elif op == "barrier":
                     r = self._handle_barrier(msg, conn)
                     if r == "wait":

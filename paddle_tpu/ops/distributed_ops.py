@@ -44,8 +44,11 @@ def send(ctx: ExecContext):
         if comm is not None and comm.is_running and name in comm.send_ctx:
             comm.push(name, val)
             continue
-        if hasattr(val, "rows"):  # SelectedRows: whole-table to one endpoint
-            client.send_var(epmap[0], name, val)
+        if hasattr(val, "rows"):  # SelectedRows sparse grad
+            from ..distributed.ps_rpc import send_sparse_sections
+
+            send_sparse_sections(client, name, val, epmap,
+                                 list(ctx.attr("begins", [0])), sections)
             continue
         from ..distributed.ps_rpc import send_sections
 
@@ -109,3 +112,48 @@ def listen_and_serv(ctx: ExecContext):
     )
     rt.serve()
     return {}
+
+
+@register_op("prefetch", grad="none", host=True)
+def prefetch(ctx: ExecContext):
+    """Distributed-lookup-table forward (reference parameter_prefetch.cc +
+    distribute_transpiler.py:1503 rewrite of lookup_table): gather only the
+    batch's rows from the row-sharded server tables. inputs Ids [.., 1] or
+    [..]; outputs Out [.., D]; attrs: table_name, epmap (per block), begins,
+    sections (rows per block), padding_idx."""
+    client = _client(ctx)
+    epmap = list(ctx.attr("epmap", []))
+    begins = list(ctx.attr("begins", [0]))
+    sections = list(ctx.attr("sections", []))
+    table = ctx.attr("table_name")
+    padding_idx = int(ctx.attr("padding_idx", -1))
+
+    ids = np.asarray(ctx.input("Ids"))
+    idsq = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    flat = idsq.reshape(-1).astype(np.int64)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    if not sections:
+        out_rows = client.prefetch(epmap[0], table, uniq)
+    else:
+        ends = [b + s for b, s in zip(begins, sections)]
+        if uniq.size and (uniq.min() < begins[0] or uniq.max() >= ends[-1]):
+            raise IndexError(
+                f"prefetch: ids outside the sharded table '{table}' "
+                f"[{begins[0]}, {ends[-1]}): min={uniq.min()} "
+                f"max={uniq.max()} — corrupt data or wrong vocab size")
+        # an empty-id batch still needs the embedding WIDTH for a
+        # shape-correct [.., 0-rows, D] output: ask block0 for zero rows
+        out_rows = None
+        for j, (ep, b, e) in enumerate(zip(epmap, begins, ends)):
+            mask = (uniq >= b) & (uniq < e)
+            if not mask.any() and out_rows is not None:
+                continue
+            part = client.prefetch(ep, f"{table}.block{j}", uniq[mask] - b)
+            if out_rows is None:
+                out_rows = np.zeros((len(uniq), part.shape[1]), part.dtype)
+            out_rows[mask] = part
+    out = out_rows[inv].reshape(idsq.shape + (out_rows.shape[1],))
+    if padding_idx >= 0:
+        out = np.where((idsq == padding_idx)[..., None],
+                       np.zeros_like(out), out)
+    return {"Out": out}

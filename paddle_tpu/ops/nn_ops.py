@@ -457,3 +457,25 @@ def softmax_mask_fuse_upper_triangle(ctx: ExecContext):
     mask = jnp.tril(jnp.ones((q, k), bool))
     neg = jnp.asarray(-1e9 if x.dtype != jnp.float16 else -6e4, x.dtype)
     return {"Out": jax.nn.softmax(jnp.where(mask, x, neg), axis=-1)}
+
+
+@register_op("lookup_table_grad_rows", grad="none")
+def lookup_table_grad_rows(ctx: ExecContext):
+    """Gradient for a DISTRIBUTED lookup table (transpiler-rewritten from
+    lookup_table_grad): builds the SelectedRows row-gradient from Ids +
+    Out@GRAD alone — the table itself lives on the pservers and is not in
+    the trainer scope (reference lookup_table rewrite,
+    distribute_transpiler.py:1503)."""
+    from ..core.selected_rows import SelectedRows
+
+    ids, og = ctx.input("Ids"), ctx.input("Out@GRAD")
+    height = int(ctx.attr("height"))
+    idsq = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    width = og.shape[-1]
+    rows = idsq.reshape(-1).astype(np.int32)
+    vals = og.reshape(-1, width)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = jnp.where((rows == padding_idx)[:, None],
+                         jnp.zeros_like(vals), vals)
+    return {"W@GRAD": SelectedRows(rows, vals, height=height)}

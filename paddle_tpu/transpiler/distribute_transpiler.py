@@ -29,7 +29,12 @@ import math
 
 import numpy as np
 
-from ..framework import Program, default_main_program, default_startup_program
+from ..framework import (
+    Operator,
+    Program,
+    default_main_program,
+    default_startup_program,
+)
 from .ps_dispatcher import RoundRobin
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig", "slice_variable"]
@@ -110,6 +115,14 @@ class DistributeTranspiler:
             for op in block.ops
             if op.type.startswith("lookup_table") and op.attr("is_sparse", False)
         }
+        # distributed lookup tables (embedding(..., is_distributed=True)):
+        # row-sharded across ALL pservers with trainer-side prefetch
+        # (reference distribute_transpiler.py:1503-1656)
+        self.dist_tables = {
+            op.inputs["W"][0]
+            for op in block.ops
+            if op.type == "lookup_table" and op.attr("is_distributed", False)
+        }
 
         # placement: size-desc round robin (reference same-size balancing)
         infos = []
@@ -125,6 +138,32 @@ class DistributeTranspiler:
         dispatcher = self.config.split_method(self.eps)
         self.param_blocks = []  # per param: {param, grad, eps, sections, sparse, specs}
         for info in infos:
+            if info["param"] in self.dist_tables:
+                if info["op"].type != "sgd":
+                    raise NotImplementedError(
+                        f"distributed lookup table '{info['param']}' needs "
+                        "an accumulator-free optimizer (SGD) — sparse "
+                        "accumulator sharding is not implemented")
+                # even row split across every server, no size threshold:
+                # the whole point is a table too big for one host
+                n = len(self.eps)
+                rows = info["var"].shape[0]
+                per = int(math.ceil(rows / n))
+                begins, sections, eps = [], [], []
+                b = 0
+                for j in range(n):
+                    if b >= rows:
+                        break
+                    size = min(per, rows - b)
+                    begins.append(b)
+                    sections.append(size)
+                    eps.append(self.eps[j])
+                    b += size
+                self.param_blocks.append({
+                    **info, "sparse": True, "eps": eps, "sections": sections,
+                    "begins": begins, "dist_table": True,
+                })
+                continue
             sliceable = (
                 self.config.slice_var_up
                 and not info["sparse"]
@@ -250,24 +289,39 @@ class DistributeTranspiler:
                             startup_program=None):
         """Pserver init: the ORIGINAL startup program — equal random_seed
         makes pserver param init identical to trainer init (replaces the
-        reference's moved init ops)."""
-        return startup_program or self.startup_program
+        reference's moved init ops). When distributed tables exist, the
+        trainer's startup was stripped of their init ops, so the stashed
+        pre-rewrite copy serves the pserver role."""
+        if startup_program is not None:
+            return startup_program
+        return getattr(self, "_pserver_startup", None) or self.startup_program
 
     # -- trainer side --------------------------------------------------------
     def _rewrite_trainer_program(self):
+        # the pserver role needs the ORIGINAL startup (it initializes +
+        # slices the full tables); the trainer's startup is about to lose
+        # the distributed tables' init ops, so stash a deep copy first
+        self._pserver_startup = Program.from_dict(self.startup_program.to_dict())
         block = self.origin_program.global_block
         opt_set = set(id(op) for op in self._opt_ops)
         block.ops = [op for op in block.ops if id(op) not in opt_set]
+        if self.dist_tables:
+            self._rewrite_dist_tables()
         common = {"endpoints": self.eps, "trainer_id": self.trainer_id}
+        dist_begins = {pb["grad"]: pb["begins"] for pb in self.param_blocks
+                       if pb.get("dist_table")}
         for pb in self.param_blocks:
             block.append_op(
                 "send", {"X": [pb["grad"]]}, {},
                 {"epmap": pb["eps"], "sections": pb["sections"],
+                 "begins": dist_begins.get(pb["grad"], []),
                  "sparse": pb["sparse"], **common},
             )
         if self.sync_mode:
             block.append_op("send_barrier", {}, {}, dict(common))
             for pb in self.param_blocks:
+                if pb.get("dist_table"):
+                    continue  # never pulled whole — prefetch reads rows
                 block.append_op(
                     "recv", {}, {"Out": [pb["param"]]},
                     {"epmap": pb["eps"], "sections": pb["sections"], **common},
@@ -277,6 +331,82 @@ class DistributeTranspiler:
         # recv thread refreshes parameters (reference async trainer program,
         # communicator.h:162; recv ops would re-introduce a sync round-trip
         # per step)
+
+    def _rewrite_dist_tables(self):
+        """Rewrite every distributed table's ops on the trainer (reference
+        distribute_transpiler.py:1503 _replace_lookup_table_op_with_prefetch
+        + :1656 grad rewrite):
+          * forward lookup_table -> prefetch (only the batch's rows travel)
+          * backward lookup_table_grad -> lookup_table_grad_rows (builds the
+            SelectedRows grad WITHOUT the table value)
+          * the table's startup init ops are dropped — a vocab too big to
+            replicate must never materialize in the trainer scope.
+        """
+        block = self.origin_program.global_block
+        by_param = {pb["param"]: pb for pb in self.param_blocks
+                    if pb.get("dist_table")}
+        new_ops = []
+        for op in block.ops:
+            if (op.type == "lookup_table"
+                    and op.inputs["W"][0] in by_param):
+                pb = by_param[op.inputs["W"][0]]
+                nop = Operator(
+                    block, "prefetch",
+                    {"Ids": list(op.inputs["Ids"])},
+                    {"Out": list(op.outputs["Out"])},
+                    {
+                        "table_name": pb["param"],
+                        "epmap": pb["eps"], "begins": pb["begins"],
+                        "sections": pb["sections"],
+                        "endpoints": self.eps,
+                        "trainer_id": self.trainer_id,
+                        "padding_idx": op.attr("padding_idx", -1),
+                    })
+                new_ops.append(nop)
+            elif (op.type == "lookup_table_grad"
+                    and op.inputs.get("W", [""])[0] in by_param):
+                pb = by_param[op.inputs["W"][0]]
+                nop = Operator(
+                    block, "lookup_table_grad_rows",
+                    {"Ids": list(op.inputs["Ids"]),
+                     "Out@GRAD": list(op.inputs["Out@GRAD"])},
+                    {"W@GRAD": list(op.outputs["W@GRAD"])},
+                    {"height": int(pb["var"].shape[0]),
+                     "padding_idx": op.attr("padding_idx", -1)})
+                new_ops.append(nop)
+            else:
+                new_ops.append(op)
+        block.ops = new_ops
+        # neutralize the big tables' init ops in the TRAINER startup: the
+        # table must never materialize, but the op cannot simply be DELETED —
+        # startup randomness is a sequential split stream, so removal would
+        # shift every later init away from the pserver's (which runs the
+        # original startup), desynchronizing step-1 gradients. Keep the op
+        # (same RNG consumption), point it at a [1]-shaped throwaway.
+        from ..ops.registry import get_op_def, has_op
+
+        sblock = self.startup_program.global_block
+        for op in sblock.ops:
+            hit = set(op.output_names) & set(by_param)
+            if not hit:
+                continue
+            if has_op(op.type) and get_op_def(op.type).needs_rng:
+                dummy = sblock.create_var(
+                    name=next(iter(hit)) + "@INIT_DROPPED", shape=[1],
+                    dtype="float32")
+                op.outputs = {s: [dummy.name if n in by_param else n
+                                  for n in ns]
+                              for s, ns in op.outputs.items()}
+                if "shape" in op.attrs:
+                    op.attrs = {**op.attrs, "shape": [1]}
+            else:
+                op.type = "fill_constant"
+                dummy = sblock.create_var(
+                    name=next(iter(hit)) + "@INIT_DROPPED", shape=[1],
+                    dtype="float32")
+                op.inputs = {}
+                op.outputs = {"Out": [dummy.name]}
+                op.attrs = {"shape": [1], "dtype": "float32", "value": 0.0}
 
     def get_trainer_program(self, wait_port=True) -> Program:
         return self.origin_program
@@ -288,7 +418,13 @@ class DistributeTranspiler:
         send_ctx, recv_ctx = {}, {}
         for pb in self.param_blocks:
             send_ctx[pb["grad"]] = {"epmap": pb["eps"],
-                                    "sections": pb["sections"]}
+                                    "sections": pb["sections"],
+                                    "begins": pb["begins"]}
+            if pb.get("dist_table"):
+                # never pulled whole: the prefetch op reads fresh rows per
+                # batch, and materializing the table would defeat the
+                # feature's memory contract
+                continue
             recv_ctx[pb["param"]] = {"epmap": pb["eps"],
                                      "sections": pb["sections"]}
         return send_ctx, recv_ctx

@@ -1,0 +1,118 @@
+"""Distributed-lookup-table runner (reference dist_ctr.py + the
+distributed lookup table rewrite): an embedding too big to replicate is
+row-sharded over the pservers; trainers prefetch only the batch's rows and
+ship SelectedRows grads routed per slice. Sync mode must reproduce the
+single-process DENSE trajectory exactly.
+
+usage: dist_lookup.py ROLE EPS TRAINER_ID N_TRAINERS OUT_NPZ [CURRENT_EP]
+"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+
+import os  # noqa: E402
+
+STEPS = int(os.environ.get("DIST_LOOKUP_STEPS", "5"))
+FULL_BATCH = 32
+VOCAB = 1000
+FIELDS = 4
+DIM = 8
+
+
+def build(distributed: bool):
+    ids = L.data(name="ids", shape=[FIELDS], dtype="int64")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    emb = L.embedding(ids, size=[VOCAB, DIM], is_sparse=distributed,
+                      is_distributed=distributed,
+                      param_attr=pt.ParamAttr(name="big_emb"))
+    pooled = L.reduce_sum(emb, dim=1)
+    h = L.fc(pooled, size=16, act="relu")
+    pred = L.fc(h, size=1)
+    return L.mean(L.square_error_cost(pred, y))
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, VOCAB, (FULL_BATCH, FIELDS)).astype(np.int64)
+    y = (np.sin(ids.sum(axis=1, keepdims=True) / 100.0)).astype(np.float32)
+    return ids, y
+
+
+def main():
+    role, eps, trainer_id, n_trainers, out = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5])
+    current_ep = sys.argv[6] if len(sys.argv) > 6 else None
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            loss = build(distributed=role != "local")
+            pt.optimizer.SGD(0.1).minimize(loss)
+
+    exe = pt.Executor()
+    ids, y = full_data()
+
+    if role == "local":
+        exe.run(startup)
+        for _ in range(STEPS):
+            (lv,) = exe.run(main_p, feed={"ids": ids, "y": y},
+                            fetch_list=[loss.name])
+        _dump(out, main_p, float(np.asarray(lv).reshape(-1)[0]))
+        return
+
+    t = pt.DistributeTranspiler()
+    t.transpile(trainer_id, program=main_p, pservers=eps,
+                trainers=n_trainers, sync_mode=True,
+                startup_program=startup)
+
+    if role == "pserver":
+        exe.run(t.get_startup_program())
+        exe.run(t.get_pserver_program(current_ep))
+        return
+
+    # trainer: its startup no longer initializes big_emb — assert that
+    exe.run(startup)
+    assert pt.global_scope().find_var("big_emb") is None, (
+        "distributed table materialized in the trainer scope")
+    prog = t.get_trainer_program()
+    shard = FULL_BATCH // n_trainers
+    lo = trainer_id * shard
+    for _ in range(STEPS):
+        (lv,) = exe.run(prog, feed={"ids": ids[lo:lo + shard],
+                                    "y": y[lo:lo + shard]},
+                        fetch_list=[loss.name])
+    # pull the final sharded table for the oracle comparison BEFORE closing
+    # (close -> send_complete -> the last trainer's close shuts the servers
+    # down). Test-only: production uses save_persistables/checkpoint_notify.
+    from paddle_tpu.distributed.ps_rpc import PSClient, fetch_sections
+
+    pb = next(p for p in t.param_blocks if p["param"] == "big_emb")
+    client = PSClient.get(tuple(t.eps), trainer_id)
+    table = fetch_sections(client, "big_emb", pb["eps"], pb["sections"])
+    exe.close()
+    vals = {p.name: np.asarray(pt.global_scope().find_var(p.name))
+            for p in main_p.all_parameters()
+            if pt.global_scope().find_var(p.name) is not None}
+    vals["big_emb"] = table
+    vals["__last_loss__"] = np.asarray(lv)
+    np.savez(out, **vals)
+
+
+def _dump(out, program, last_loss):
+    vals = {p.name: np.asarray(pt.global_scope().find_var(p.name))
+            for p in program.all_parameters()}
+    vals["__last_loss__"] = np.asarray(last_loss)
+    np.savez(out, **vals)
+
+
+if __name__ == "__main__":
+    main()

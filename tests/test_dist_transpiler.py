@@ -116,3 +116,61 @@ def test_transpile_requires_optimize_ops():
         with pytest.raises(ValueError, match="minimize"):
             pt.DistributeTranspiler().transpile(
                 0, program=pt.default_main_program(), trainers=1)
+
+
+def test_distributed_lookup_table_rewrite():
+    """embedding(is_distributed=True) rewrite (reference
+    distribute_transpiler.py:1503-1656): forward lookup_table -> prefetch,
+    backward -> lookup_table_grad_rows, table row-sharded across every
+    pserver, no whole-table recv, trainer startup init neutralized WITHOUT
+    shifting the RNG stream of later init ops."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers as L
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = startup.random_seed = 3
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            ids = L.data(name="ids", shape=[4], dtype="int64")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            emb = L.embedding(ids, size=[100, 8], is_sparse=True,
+                              is_distributed=True,
+                              param_attr=pt.ParamAttr(name="big_emb"))
+            pooled = L.reduce_sum(emb, dim=1)
+            pred = L.fc(pooled, size=1)
+            loss = L.mean(L.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+
+    n_startup_ops = len(startup.global_block.ops)
+    t = pt.DistributeTranspiler()
+    t.transpile(0, program=main_p, pservers="ep0:1,ep1:2", trainers=2,
+                sync_mode=True, startup_program=startup)
+
+    ops = [op.type for op in main_p.global_block.ops]
+    assert "prefetch" in ops and "lookup_table" not in ops
+    assert "lookup_table_grad_rows" in ops and "lookup_table_grad" not in ops
+
+    # table sliced evenly: 50 rows per server, sparse optimize blocks
+    for ep in ("ep0:1", "ep1:2"):
+        tbl = [s for s in t._ep_specs[ep] if s["origin_param"] == "big_emb"]
+        assert len(tbl) == 1 and tbl[0]["sparse"] and tbl[0]["rows"] == 50
+
+    # the sparse send carries begins for per-slice row routing
+    send = next(op for op in main_p.global_block.ops
+                if op.type == "send" and op.inputs["X"][0].startswith("big_emb"))
+    assert send.attrs["sections"] == [50, 50]
+    assert send.attrs["begins"] == [0, 50]
+
+    # no recv ever pulls the whole table
+    recvs = [op.outputs["Out"][0] for op in main_p.global_block.ops
+             if op.type == "recv"]
+    assert "big_emb" not in recvs
+
+    # trainer startup: table init neutralized, op COUNT preserved (RNG
+    # stream alignment with the pserver startup), pserver startup intact
+    s_outs = [n for op in startup.global_block.ops for n in op.output_names]
+    assert "big_emb" not in s_outs
+    assert len(startup.global_block.ops) == n_startup_ops
+    ps_outs = [n for op in t.get_startup_program().global_block.ops
+               for n in op.output_names]
+    assert "big_emb" in ps_outs
