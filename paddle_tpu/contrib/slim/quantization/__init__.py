@@ -1,1 +1,6 @@
-from .quantization_pass import QuantizationTransformPass  # noqa: F401
+from .quantization_pass import (  # noqa: F401
+    ConvertToInt8Pass,
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+)
+from .post_training_quantization import PostTrainingQuantization  # noqa: F401

@@ -1,0 +1,118 @@
+"""Post-training quantization: calibrate activation scales on sample data,
+then insert static quantize-dequantize ops — no retraining.
+
+TPU-native equivalent of the reference's post-training paths (contrib/slim
+calibration + the int8 mkldnn calibrator, reference
+contrib/slim/quantization/quantization_pass.py family): where QAT learns
+moving-average scales during training, PTQ measures abs-max statistics by
+RUNNING the trained inference program over a calibration set, then rewrites
+the program with fixed-scale q/dq ops. `QuantizationFreezePass` +
+`save_inference_model` afterwards produce the deployable quantized model
+(optionally `ConvertToInt8Pass` for 1-byte weights).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....framework import Program
+
+__all__ = ["PostTrainingQuantization"]
+
+_DEFAULT_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+
+class PostTrainingQuantization:
+    """Usage::
+
+        ptq = PostTrainingQuantization(
+            executor=exe, program=inference_program,
+            sample_feeds=[{...}, ...],          # calibration batches
+            scope=scope)                         # holds trained params
+        quant_program = ptq.quantize()           # static-scale q/dq inserted
+        QuantizationFreezePass(scope).apply(quant_program)
+        io.save_inference_model(...)
+    """
+
+    def __init__(self, executor, program: Program, sample_feeds,
+                 scope=None, quantizable_op_type=_DEFAULT_QUANTIZABLE,
+                 weight_bits=8, activation_bits=8, algo="abs_max"):
+        from ....executor import global_scope
+
+        if algo != "abs_max":
+            raise NotImplementedError(
+                f"calibration algo '{algo}' — only abs_max is implemented")
+        if not sample_feeds:
+            raise ValueError("PTQ needs at least one calibration batch")
+        self._exe = executor
+        self._program = program
+        self._feeds = list(sample_feeds)
+        self._scope = scope or global_scope()
+        self._types = tuple(quantizable_op_type)
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+
+    def quantize(self) -> Program:
+        block = self._program.global_block
+        params = {p.name for p in self._program.all_parameters()}
+
+        # 1. the tensors feeding quantizable ops
+        act_names, weight_names = [], []
+        for op in block.ops:
+            if op.type not in self._types:
+                continue
+            for names in op.inputs.values():
+                for n in names:
+                    if not n or not block.has_var(n):
+                        continue
+                    if n in params:
+                        if n not in weight_names:
+                            weight_names.append(n)
+                    elif n not in act_names:
+                        act_names.append(n)
+
+        # 2. calibrate: abs-max of each activation over the sample batches
+        act_scales = {n: 0.0 for n in act_names}
+        from ....executor import scope_guard
+
+        with scope_guard(self._scope):
+            for feed in self._feeds:
+                outs = self._exe.run(self._program, feed=feed,
+                                     fetch_list=act_names)
+                for n, v in zip(act_names, outs):
+                    act_scales[n] = max(act_scales[n],
+                                        float(np.abs(np.asarray(v)).max()))
+
+        # 3. weight scales straight from the trained values
+        weight_scales = {
+            n: float(np.abs(np.asarray(self._scope.find_var(n))).max())
+            for n in weight_names}
+
+        # 4. rewrite: static q/dq in front of every quantizable op
+        from .... import unique_name
+
+        quantized: dict[str, str] = {}
+        for op in list(block.ops):
+            if op.type not in self._types:
+                continue
+            for slot, names in op.inputs.items():
+                for i, n in enumerate(names):
+                    if n in quantized:
+                        names[i] = quantized[n]
+                        continue
+                    scale = weight_scales.get(n, act_scales.get(n))
+                    if scale is None:
+                        continue
+                    bits = (self._weight_bits if n in weight_scales
+                            else self._activation_bits)
+                    var = block.var(n)
+                    out = block.create_var(
+                        name=unique_name.generate(n + ".ptq"),
+                        shape=var.shape, dtype=var.dtype)
+                    block._insert_op(
+                        block.ops.index(op), "fake_quantize_dequantize_static",
+                        {"X": [n]}, {"Out": [out.name]},
+                        {"scale": max(scale, 1e-8), "bit_length": bits})
+                    quantized[n] = out.name
+                    names[i] = out.name
+        self._program._bump_version()
+        return self._program

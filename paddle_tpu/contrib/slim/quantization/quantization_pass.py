@@ -106,3 +106,151 @@ class QuantizationTransformPass:
                 {"bit_length": self._activation_bits,
                  "moving_rate": self._moving_rate, "is_test": for_test})
         return out.name
+
+
+_FAKE_WEIGHT_OPS = ("fake_quantize_dequantize_abs_max",)
+_FAKE_ACT_OPS = ("fake_quantize_dequantize_moving_average_abs_max",)
+_FAKE_STATIC = "fake_quantize_dequantize_static"
+
+
+class QuantizationFreezePass:
+    """Convert a QAT-trained (or PTQ-calibrated) program into an inference
+    program (reference quantization_pass.py QuantizationFreezePass):
+
+      * weight fake-q/dq ops are removed and the SCOPE weight is overwritten
+        with its quantize-dequantized value — inference math equals the QAT
+        forward exactly; the weight's abs-max scale is stored in a
+        persistable `<w>@quant_scale` var for the int8 convert step;
+      * activation fake ops are removed (consumers rewired to the raw
+        input); the learned/calibrated scale is recorded on each consumer op
+        as an `in_scales` attr — the quantization metadata an int8 engine
+        needs at runtime, without burdening the fp simulation.
+
+    Apply AFTER training, BEFORE save_inference_model."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8):
+        from ....executor import global_scope
+
+        self._scope = scope or global_scope()
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+
+    def apply(self, program=None):
+        import numpy as np
+
+        from ....framework import default_main_program
+
+        program = program or default_main_program()
+        block = program.global_block
+        params = {p.name for p in program.all_parameters()}
+        replace: dict[str, str] = {}   # fake-out name -> original input
+        act_scales: dict[str, float] = {}  # rewired input name -> scale
+        new_ops = []
+        for op in block.ops:
+            if op.type in _FAKE_WEIGHT_OPS or (
+                    op.type == _FAKE_STATIC
+                    and op.inputs["X"][0] in params):
+                w_name = op.inputs["X"][0]
+                out_name = op.outputs["Out"][0]
+                w = np.asarray(self._scope.find_var(w_name))
+                n = float(2 ** (self._weight_bits - 1) - 1)
+                scale = float(np.abs(w).max()) if op.type != _FAKE_STATIC \
+                    else float(op.attrs["scale"])
+                scale = max(scale, 1e-8)
+                q = np.clip(np.round(w / scale * n), -n, n)
+                self._scope.set_var(w_name, (q * scale / n).astype(w.dtype))
+                sname = w_name + "@quant_scale"
+                block.create_var(name=sname, shape=(1,), dtype="float32",
+                                 persistable=True)
+                self._scope.set_var(sname, np.asarray([scale], np.float32))
+                replace[out_name] = w_name
+                continue
+            if op.type in _FAKE_ACT_OPS or (
+                    op.type == _FAKE_STATIC
+                    and op.inputs["X"][0] not in params):
+                x_name = op.inputs["X"][0]
+                out_name = op.outputs["Out"][0]
+                if op.type == _FAKE_STATIC:
+                    scale = float(op.attrs["scale"])
+                else:
+                    sv = self._scope.find_var(op.inputs["InScale"][0])
+                    if sv is None:
+                        raise RuntimeError(
+                            f"QuantizationFreezePass: moving-average scale "
+                            f"'{op.inputs['InScale'][0]}' not in the scope — "
+                            "pass the scope QAT trained in (a silent 0.0 "
+                            "scale would poison the in_scales metadata)")
+                    scale = float(np.asarray(sv).reshape(-1)[0])
+                replace[out_name] = x_name
+                act_scales[x_name] = scale
+                continue
+            new_ops.append(op)
+        for op in new_ops:
+            scales = {}
+            for slot, names in op.inputs.items():
+                for i, nme in enumerate(names):
+                    if nme in replace:
+                        names[i] = replace[nme]
+                    if names[i] in act_scales:
+                        scales[names[i]] = act_scales[names[i]]
+            if scales:
+                op.attrs = {**op.attrs, "in_scales": scales}
+        block.ops = new_ops
+        program._bump_version()
+        return program
+
+
+class ConvertToInt8Pass:
+    """Store frozen weights as int8 (reference ConvertToInt8Pass): each
+    frozen-quantized weight var flips to int8 in program + scope, and a
+    `dequantize_abs_max` op is inserted before its consumers — the saved
+    model carries 1-byte weights and dequantizes at run time."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8):
+        from ....executor import global_scope
+
+        self._scope = scope or global_scope()
+        self._weight_bits = weight_bits
+
+    def apply(self, program=None):
+        import numpy as np
+
+        from .... import unique_name
+        from ....framework import default_main_program
+
+        program = program or default_main_program()
+        block = program.global_block
+        n = float(2 ** (self._weight_bits - 1) - 1)
+        converted: dict[str, str] = {}  # weight -> dequantized var name
+        for w_name in [v for v in list(block.vars)
+                       if block.has_var(v + "@quant_scale")]:
+            w = np.asarray(self._scope.find_var(w_name))
+            scale = float(np.asarray(
+                self._scope.find_var(w_name + "@quant_scale")).reshape(-1)[0])
+            q = np.clip(np.round(w / max(scale, 1e-8) * n), -n, n)
+            self._scope.set_var(w_name, q.astype(np.int8))
+            from ....core.types import DType
+
+            block.var(w_name).dtype = DType.INT8
+            deq = block.create_var(
+                name=unique_name.generate(w_name + ".deq"),
+                shape=w.shape, dtype="float32")
+            converted[w_name] = deq.name
+        if not converted:
+            return program
+        # insert one dequantize per weight at the top; rewire consumers
+        for i, (w_name, deq_name) in enumerate(sorted(converted.items())):
+            block._insert_op(
+                i, "dequantize_abs_max",
+                {"X": [w_name], "Scale": [w_name + "@quant_scale"]},
+                {"Out": [deq_name]}, {"bit_length": self._weight_bits})
+        for op in block.ops:
+            if op.type == "dequantize_abs_max":
+                continue
+            for slot, names in op.inputs.items():
+                for j, nme in enumerate(names):
+                    if nme in converted:
+                        names[j] = converted[nme]
+        program._bump_version()
+        return program

@@ -471,6 +471,12 @@ def lookup_table_grad_rows(ctx: ExecContext):
     ids, og = ctx.input("Ids"), ctx.input("Out@GRAD")
     height = int(ctx.attr("height"))
     idsq = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    if og is None:
+        # output's grad never materialized (grad-pruned consumer): an empty
+        # row set, same degrade as lookup_table_grad's zeros
+        return {"W@GRAD": SelectedRows(
+            jnp.zeros((0,), jnp.int32), jnp.zeros((0, 1), jnp.float32),
+            height=height)}
     width = og.shape[-1]
     rows = idsq.reshape(-1).astype(np.int32)
     vals = og.reshape(-1, width)

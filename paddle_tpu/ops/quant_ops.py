@@ -65,28 +65,16 @@ def _fqdq_ma_grad(ctx: ExecContext):
     return {"X@GRAD": ctx.input("Out@GRAD")}
 
 
-def _fqdq_ma_grad_maker(op, block, no_grad_set=frozenset()):
+def _ste_grad_maker(op, block, no_grad_set=frozenset()):
+    """Shared straight-through-estimator grad maker: every fake-quant
+    variant's grad op is `<type>_grad` reading only Out@GRAD."""
     from ..framework import grad_var_name
 
     x = op.input("X")[0]
     if x in no_grad_set:
         return []
     return [{
-        "type": "fake_quantize_dequantize_moving_average_abs_max_grad",
-        "inputs": {"Out@GRAD": [grad_var_name(op.output("Out")[0])]},
-        "outputs": {"X@GRAD": [grad_var_name(x)]},
-        "attrs": dict(op.attrs),
-    }]
-
-
-def _fqdq_grad_maker(op, block, no_grad_set=frozenset()):
-    from ..framework import grad_var_name
-
-    x = op.input("X")[0]
-    if x in no_grad_set:
-        return []
-    return [{
-        "type": "fake_quantize_dequantize_abs_max_grad",
+        "type": op.type + "_grad",
         "inputs": {"Out@GRAD": [grad_var_name(op.output("Out")[0])]},
         "outputs": {"X@GRAD": [grad_var_name(x)]},
         "attrs": dict(op.attrs),
@@ -95,7 +83,37 @@ def _fqdq_grad_maker(op, block, no_grad_set=frozenset()):
 
 from .registry import get_op_def  # noqa: E402
 
-get_op_def("fake_quantize_dequantize_abs_max").grad_maker = _fqdq_grad_maker
+get_op_def("fake_quantize_dequantize_abs_max").grad_maker = _ste_grad_maker
 get_op_def(
     "fake_quantize_dequantize_moving_average_abs_max"
-).grad_maker = _fqdq_ma_grad_maker
+).grad_maker = _ste_grad_maker
+
+
+@register_op("fake_quantize_dequantize_static")
+def fake_quantize_dequantize_static(ctx: ExecContext):
+    """Quantize-dequantize with a FIXED calibrated scale (the PTQ path:
+    reference post-training calibration writes static scales where QAT
+    learns moving averages)."""
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    scale = jnp.asarray(float(ctx.attr("scale")), jnp.float32)
+    return {"Out": _qdq(x, scale, bits).astype(x.dtype)}
+
+
+@register_grad_compute("fake_quantize_dequantize_static")
+def _fqdq_static_grad(ctx: ExecContext):
+    return {"X@GRAD": ctx.input("Out@GRAD")}
+
+
+get_op_def("fake_quantize_dequantize_static").grad_maker = _ste_grad_maker
+
+
+@register_op("dequantize_abs_max", grad="none")
+def dequantize_abs_max(ctx: ExecContext):
+    """int8 weight -> float (reference fake_dequantize_op.cc
+    FakeDequantizeMaxAbs): Out = X * Scale / (2^(bits-1)-1). Inserted by
+    ConvertToInt8Pass so int8-stored models execute."""
+    x, scale = ctx.input("X"), ctx.input("Scale")
+    bits = int(ctx.attr("bit_length", 8))
+    n = float(2 ** (bits - 1) - 1)
+    return {"Out": x.astype(jnp.float32) * scale.reshape(()) / n}

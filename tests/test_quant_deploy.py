@@ -1,0 +1,145 @@
+"""Quantization deployment paths (reference slim QuantizationFreezePass /
+ConvertToInt8Pass / post-training calibration): QAT -> freeze ->
+save_inference_model round trip, int8 weight storage, and PTQ calibration."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.contrib.slim.quantization import (
+    ConvertToInt8Pass,
+    PostTrainingQuantization,
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+)
+
+
+def _build_and_train(qat: bool, steps=60, seed=3):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 7
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[8], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            pred = L.fc(L.fc(x, size=16, act="relu"), size=1)
+            loss = L.mean(L.square_error_cost(pred, y))
+            if qat:
+                QuantizationTransformPass().apply(main, startup)
+            # inference program BEFORE minimize (reference clone(for_test))
+            test_prog = main.clone(for_test=True)
+            pt.optimizer.SGD(0.05).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            xb = rng.standard_normal((32, 8)).astype(np.float32)
+            exe.run(main, feed={"x": xb, "y": xb @ w}, fetch_list=[loss])
+    return main, test_prog, scope, exe, pred, w
+
+
+def test_qat_freeze_save_load_roundtrip(tmp_path):
+    main, test_prog, scope, exe, pred, w = _build_and_train(qat=True)
+    rng = np.random.default_rng(11)
+    xq = rng.standard_normal((16, 8)).astype(np.float32)
+    with pt.scope_guard(scope):
+        # QAT-mode reference output from the TEST program (the training
+        # program would apply an SGD step as a side effect of the fetch)
+        (ref,) = exe.run(test_prog, feed={"x": xq, "y": np.zeros((16, 1), np.float32)},
+                         fetch_list=[pred.name])
+        ref = np.asarray(ref)
+
+        infer = test_prog.clone(for_test=True)
+        QuantizationFreezePass(scope).apply(infer)
+        types = [op.type for op in infer.global_block.ops]
+        assert not any("fake_quantize" in t for t in types), types
+        # quantization metadata survives on the consumer ops
+        assert any("in_scales" in op.attrs for op in infer.global_block.ops)
+        # frozen weights are quantized levels: <= 2^8 distinct values
+        fcw = np.asarray(scope.find_var("fc_0.w_0"))
+        assert len(np.unique(fcw)) <= 255
+        (frozen_out,) = exe.run(infer, feed={"x": xq, "y": np.zeros((16, 1), np.float32)},
+                                fetch_list=[pred.name])
+        # freeze keeps the qdq'd weights but drops activation fakes: close,
+        # not identical
+        np.testing.assert_allclose(np.asarray(frozen_out), ref,
+                                   rtol=0.15, atol=0.05)
+
+        d = str(tmp_path / "qmodel")
+        pt.io.save_inference_model(d, ["x"], [infer.global_block.var(pred.name)],
+                                   exe, main_program=infer, scope=scope)
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        prog2, feeds2, fetches2 = pt.io.load_inference_model(d, exe)
+        (out2,) = exe.run(prog2, feed={"x": xq}, fetch_list=fetches2)
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(frozen_out), rtol=1e-5)
+
+
+def test_convert_to_int8_stores_int8_weights(tmp_path):
+    main, test_prog, scope, exe, pred, w = _build_and_train(qat=True)
+    rng = np.random.default_rng(11)
+    xq = rng.standard_normal((16, 8)).astype(np.float32)
+    with pt.scope_guard(scope):
+        infer = test_prog.clone(for_test=True)
+        QuantizationFreezePass(scope).apply(infer)
+        (frozen_out,) = exe.run(infer, feed={"x": xq, "y": np.zeros((16, 1), np.float32)},
+                                fetch_list=[pred.name])
+        ConvertToInt8Pass(scope).apply(infer)
+        # weights now int8 in scope + program; dequantize ops present
+        fcw = np.asarray(scope.find_var("fc_0.w_0"))
+        assert fcw.dtype == np.int8
+        assert any(op.type == "dequantize_abs_max"
+                   for op in infer.global_block.ops)
+        (int8_out,) = exe.run(infer, feed={"x": xq, "y": np.zeros((16, 1), np.float32)},
+                              fetch_list=[pred.name])
+        # int8 storage must be numerically identical to the frozen fp sim
+        np.testing.assert_allclose(np.asarray(int8_out),
+                                   np.asarray(frozen_out), rtol=1e-5)
+        d = str(tmp_path / "int8model")
+        pt.io.save_inference_model(d, ["x"], [infer.global_block.var(pred.name)],
+                                   exe, main_program=infer, scope=scope)
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        prog2, feeds2, fetches2 = pt.io.load_inference_model(d, exe)
+        fcw2 = np.asarray(pt.global_scope().find_var("fc_0.w_0"))
+        assert fcw2.dtype == np.int8, "saved weights are not int8"
+        (out2,) = exe.run(prog2, feed={"x": xq}, fetch_list=fetches2)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(int8_out),
+                               rtol=1e-5)
+
+
+def test_post_training_quantization_accuracy(tmp_path):
+    # train FP32, calibrate on samples, quantize, compare predictions
+    main, test_prog, scope, exe, pred, w = _build_and_train(qat=False)
+    rng = np.random.default_rng(5)
+    calib = [{"x": rng.standard_normal((32, 8)).astype(np.float32),
+              "y": np.zeros((32, 1), np.float32)} for _ in range(4)]
+    xq = rng.standard_normal((64, 8)).astype(np.float32)
+    with pt.scope_guard(scope):
+        (fp_out,) = exe.run(test_prog, feed={"x": xq, "y": np.zeros((64, 1), np.float32)},
+                            fetch_list=[pred.name])
+        infer = test_prog.clone(for_test=True)
+        ptq = PostTrainingQuantization(exe, infer, calib, scope=scope)
+        qprog = ptq.quantize()
+        types = [op.type for op in qprog.global_block.ops]
+        assert types.count("fake_quantize_dequantize_static") >= 4, types
+        (q_out,) = exe.run(qprog, feed={"x": xq, "y": np.zeros((64, 1), np.float32)},
+                           fetch_list=[pred.name])
+        # 8-bit PTQ on a small regression head: small accuracy delta
+        err = np.abs(np.asarray(q_out) - np.asarray(fp_out)).mean()
+        ref = np.abs(np.asarray(fp_out)).mean() + 1e-6
+        assert err / ref < 0.1, (err, ref)
+        # full deploy chain: freeze + int8 + save
+        QuantizationFreezePass(scope).apply(qprog)
+        ConvertToInt8Pass(scope).apply(qprog)
+        d = str(tmp_path / "ptqmodel")
+        pt.io.save_inference_model(d, ["x"], [qprog.global_block.var(pred.name)],
+                                   exe, main_program=qprog, scope=scope)
+    scope2 = pt.Scope()
+    with pt.scope_guard(scope2):
+        prog2, _, fetches2 = pt.io.load_inference_model(d, exe)
+        (out2,) = exe.run(prog2, feed={"x": xq}, fetch_list=fetches2)
+    err2 = np.abs(np.asarray(out2) - np.asarray(fp_out)).mean()
+    assert err2 / ref < 0.1, (err2, ref)
