@@ -16,6 +16,7 @@ from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "Print",
     "fused_attention",
     "ring_attention",
     "nce",
@@ -1122,3 +1123,25 @@ def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
     helper.append_op("warpctc", inputs, {"Loss": [loss]},
                      {"blank": int(blank), "norm_by_times": norm_by_times})
     return loss
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """In-graph debug printing (reference layers/control_flow.py Print ->
+    print_op.cc): logs the tensor each execution, passes it through. The
+    print_tensor_* layout knobs are accepted for API parity; the host op
+    prints name/shape/dtype/values unconditionally."""
+    helper = LayerHelper("print", name=None)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    # host ops skip shape inference — forward the input's shape so
+    # downstream layers (fc fan-in, etc.) see the real dims
+    out.shape = tuple(input.shape)
+    helper.append_op(
+        "print", {"In": [input]}, {"Out": [out]},
+        {"first_n": first_n,
+         "message": message or input.name,
+         "summarize": summarize,
+         "print_phase": print_phase})
+    return out

@@ -322,3 +322,361 @@ def ssd_loss(ctx: ExecContext):
 
     losses = jax.vmap(per_image)(gt_box, gt_label, gt_count, loc, conf)
     return {"Loss": losses[:, None].astype(conf.dtype)}
+
+
+@register_op("roi_align")
+def roi_align(ctx: ExecContext):
+    """RoI Align (reference detection/roi_align_op.*): average of
+    `sampling_ratio^2` bilinear samples per output bin. Fixed-shape: ROIs
+    [R, 4] in image coords plus RoisBatchId [R] int (the padded stand-in for
+    the reference's LoD row mapping). Differentiable (pure gathers +
+    weighted sums -> derived vjp)."""
+    x = ctx.input("X")                    # [N, C, H, W]
+    rois = ctx.input("ROIs")              # [R, 4] (x1, y1, x2, y2)
+    batch_ids = (ctx.input("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    sr = int(ctx.attr("sampling_ratio", -1))
+    # DEPARTURE from the reference's adaptive ceil(roi_h/ph) when
+    # sampling_ratio <= 0: a data-dependent sample count cannot be a static
+    # XLA shape, so the static default is 2 samples per bin axis. Pass an
+    # explicit sampling_ratio for reference-exact pooling of large rois.
+    sr = sr if sr > 0 else 2
+
+    N, C, H, W = x.shape
+    r = rois.astype(jnp.float32) * scale
+    x1, y1, x2, y2 = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
+    roi_w = jnp.maximum(x2 - x1, 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1.0)
+    bin_w = roi_w / pw
+    bin_h = roi_h / ph
+
+    # sample grid: [R, ph, sr] y coords, [R, pw, sr] x coords
+    iy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+    py = jnp.arange(ph, dtype=jnp.float32)
+    ys = y1[:, None, None] + (py[None, :, None] + iy[None, None, :]) * bin_h[:, None, None]
+    px = jnp.arange(pw, dtype=jnp.float32)
+    xs = x1[:, None, None] + (px[None, :, None] + iy[None, None, :]) * bin_w[:, None, None]
+
+    def bilinear(img, ys, xs):
+        # img [C, H, W]; ys [ph, sr]; xs [pw, sr] -> [C, ph, sr, pw, sr].
+        # Samples outside [-1, H]/[-1, W] contribute ZERO (reference
+        # roi_align_op.h:197-202), not a clamped border value.
+        val_y = (ys >= -1.0) & (ys <= H)
+        val_x = (xs >= -1.0) & (xs <= W)
+        ysc = jnp.clip(ys, 0.0, H - 1)
+        xsc = jnp.clip(xs, 0.0, W - 1)
+        y0 = jnp.floor(ysc)
+        x0 = jnp.floor(xsc)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = ysc - y0
+        wx = xsc - x0
+        yi0, yi1 = y0.astype(jnp.int32), y1i.astype(jnp.int32)
+        xi0, xi1 = x0.astype(jnp.int32), x1i.astype(jnp.int32)
+        g = lambda yy, xx: img[:, yy][:, :, :, xx]  # [C, ph, sr, pw, sr]
+        v = (g(yi0, xi0) * ((1 - wy)[None, :, :, None, None] * (1 - wx)[None, None, None, :, :])
+             + g(yi1, xi0) * (wy[None, :, :, None, None] * (1 - wx)[None, None, None, :, :])
+             + g(yi0, xi1) * ((1 - wy)[None, :, :, None, None] * wx[None, None, None, :, :])
+             + g(yi1, xi1) * (wy[None, :, :, None, None] * wx[None, None, None, :, :]))
+        valid = (val_y[None, :, :, None, None] & val_x[None, None, None, :, :])
+        v = jnp.where(valid, v, 0.0)
+        return v.mean(axis=(2, 4))  # -> [C, ph, pw]
+
+    imgs = x[batch_ids]  # [R, C, H, W]
+    out = jax.vmap(bilinear)(imgs, ys, xs)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("roi_pool")
+def roi_pool(ctx: ExecContext):
+    """RoI max pooling (reference detection/roi_pool_op.*): adaptive integer
+    bins, max within each. Implemented as a membership-mask max — static
+    shapes for XLA (the reference's argmax bookkeeping becomes the derived
+    vjp through jnp.max)."""
+    x = ctx.input("X")                    # [N, C, H, W]
+    rois = ctx.input("ROIs")
+    batch_ids = (ctx.input("RoisBatchId").astype(jnp.int32)
+                 if ctx.has_input("RoisBatchId")
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+
+    N, C, H, W = x.shape
+    r = jnp.round(rois.astype(jnp.float32) * scale)
+    x1, y1 = r[:, 0], r[:, 1]
+    x2, y2 = r[:, 2], r[:, 3]
+    roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+
+    hs = jnp.arange(H, dtype=jnp.float32)
+    ws = jnp.arange(W, dtype=jnp.float32)
+    pyi = jnp.arange(ph, dtype=jnp.float32)
+    pxi = jnp.arange(pw, dtype=jnp.float32)
+    # bin bounds per roi/bin: [R, ph] / [R, pw]
+    hstart = jnp.floor(pyi[None, :] * roi_h[:, None] / ph) + y1[:, None]
+    hend = jnp.ceil((pyi[None, :] + 1) * roi_h[:, None] / ph) + y1[:, None]
+    wstart = jnp.floor(pxi[None, :] * roi_w[:, None] / pw) + x1[:, None]
+    wend = jnp.ceil((pxi[None, :] + 1) * roi_w[:, None] / pw) + x1[:, None]
+    in_h = ((hs[None, None, :] >= hstart[:, :, None])
+            & (hs[None, None, :] < hend[:, :, None]))     # [R, ph, H]
+    in_w = ((ws[None, None, :] >= wstart[:, :, None])
+            & (ws[None, None, :] < wend[:, :, None]))     # [R, pw, W]
+    imgs = x[batch_ids].astype(jnp.float32)               # [R, C, H, W]
+    neg = jnp.float32(-1e30)
+    # two-stage masked max keeps peak memory at O(R*C*pw*H*W') per stage
+    # instead of a monolithic [R, C, ph, pw, H, W] broadcast (infeasible at
+    # detection scale): reduce W under in_w, then H under in_h
+    v_w = jnp.where(in_w[:, None, :, None, :],             # [R,1,pw,1,W]
+                    imgs[:, :, None, :, :], neg)           # [R,C,pw,H,W]
+    v_w = v_w.max(axis=4)                                  # [R,C,pw,H]
+    v = jnp.where(in_h[:, None, None, :, :],               # [R,1,1,ph,H]
+                  v_w[:, :, :, None, :], neg)              # [R,C,pw,ph,H]
+    out = v.max(axis=4).transpose(0, 1, 3, 2)              # [R,C,ph,pw]
+    out = jnp.where(out <= neg / 2, 0.0, out)  # empty bin -> 0 (reference)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("yolo_box", grad="none")
+def yolo_box(ctx: ExecContext):
+    """YOLOv3 box decoding (reference detection/yolo_box_op.*): X
+    [N, an*(5+cls), H, W] + ImgSize [N, 2] -> Boxes [N, an*H*W, 4] in image
+    coords, Scores [N, an*H*W, cls] = sigmoid(conf)*sigmoid(cls), zeroed
+    below conf_thresh."""
+    x = ctx.input("X")
+    img_size = ctx.input("ImgSize").astype(jnp.float32)  # [N, 2] (h, w)
+    anchors = [int(a) for a in ctx.attr("anchors")]
+    class_num = int(ctx.attr("class_num"))
+    conf_thresh = float(ctx.attr("conf_thresh", 0.01))
+    downsample = int(ctx.attr("downsample_ratio", 32))
+    an = len(anchors) // 2
+    N, _, H, W = x.shape
+    x = x.reshape(N, an, 5 + class_num, H, W).astype(jnp.float32)
+
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    in_h, in_w = H * downsample, W * downsample
+
+    cx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / W
+    cy = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / H
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(conf[:, :, None] < conf_thresh,
+                      jnp.zeros_like(probs), probs)
+
+    ih = img_size[:, 0][:, None, None, None]
+    iw = img_size[:, 1][:, None, None, None]
+    x1 = (cx - bw / 2) * iw
+    y1 = (cy - bh / 2) * ih
+    x2 = (cx + bw / 2) * iw
+    y2 = (cy + bh / 2) * ih
+    # clip to image (reference clip_bbox)
+    x1 = jnp.clip(x1, 0, iw - 1)
+    y1 = jnp.clip(y1, 0, ih - 1)
+    x2 = jnp.clip(x2, 0, iw - 1)
+    y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, an * H * W, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, an * H * W, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("anchor_generator", grad="none")
+def anchor_generator(ctx: ExecContext):
+    """RPN anchors (reference detection/anchor_generator_op.*): per feature
+    cell, one anchor per (size, ratio): Anchors [H, W, A, 4] + Variances."""
+    feat = ctx.input("Input")  # [N, C, H, W]
+    sizes = [float(s) for s in ctx.attr("anchor_sizes")]
+    ratios = [float(r) for r in ctx.attr("aspect_ratios", [1.0]) or [1.0]]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in ctx.attr("stride")]
+    offset = float(ctx.attr("offset", 0.5))
+    H, W = feat.shape[2], feat.shape[3]
+
+    base = []
+    for r in ratios:
+        for s in sizes:
+            w = s * np.sqrt(1.0 / r)
+            h = s * np.sqrt(r)
+            base.append((-w / 2, -h / 2, w / 2, h / 2))
+    base = jnp.asarray(base, jnp.float32)               # [A, 4]
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    centers = jnp.stack(
+        [*jnp.meshgrid(cx, cy, indexing="xy")], axis=-1)  # [H, W, 2]
+    ctr = jnp.concatenate([centers, centers], axis=-1)    # [H, W, 4]
+    anchors = ctr[:, :, None, :] + base[None, None, :, :]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return {"Anchors": anchors, "Variances": var}
+
+
+@register_op("bipartite_match", grad="none")
+def bipartite_match(ctx: ExecContext):
+    """Greedy bipartite matching (reference detection/bipartite_match_op.cc,
+    match_type='bipartite'): repeatedly take the globally largest entry of
+    DistMat [B, R, C] (rows = gt, cols = priors), pair that row/col, mask
+    both out. Outputs per column: matched row index (-1 = unmatched) and its
+    distance. The reference's LoD batch becomes an explicit batch dim; the
+    data-dependent loop becomes a fixed-length lax.scan over min(R, C)."""
+    dist = ctx.input("DistMat").astype(jnp.float32)
+    if dist.ndim == 2:
+        dist = dist[None]
+    B, R, C = dist.shape
+
+    def one(mat):
+        def step(carry, _):
+            m, row_used, col_used, out_idx, out_d = carry
+            masked = jnp.where(row_used[:, None] | col_used[None, :],
+                               -jnp.inf, m)
+            flat = jnp.argmax(masked)
+            r, c = flat // C, flat % C
+            valid = masked[r, c] > -jnp.inf
+            out_idx = jnp.where(valid, out_idx.at[c].set(r), out_idx)
+            out_d = jnp.where(valid, out_d.at[c].set(m[r, c]), out_d)
+            row_used = jnp.where(valid, row_used.at[r].set(True), row_used)
+            col_used = jnp.where(valid, col_used.at[c].set(True), col_used)
+            return (m, row_used, col_used, out_idx, out_d), None
+
+        init = (mat, jnp.zeros(R, bool), jnp.zeros(C, bool),
+                jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), jnp.float32))
+        (_, _, _, idx, d), _ = jax.lax.scan(step, init, None,
+                                            length=min(R, C))
+        return idx, d
+
+    idx, d = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": idx, "ColToRowMatchDist": d}
+
+
+@register_op("density_prior_box", grad="none")
+def density_prior_box(ctx: ExecContext):
+    """Density prior boxes (reference detection/density_prior_box_op.*):
+    for each fixed_size/density pair, a density x density grid of shifted
+    boxes per cell at each fixed_ratio."""
+    feat = ctx.input("Input")
+    img = ctx.input("Image")
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    fixed_sizes = [float(s) for s in ctx.attr("fixed_sizes")]
+    fixed_ratios = [float(r) for r in ctx.attr("fixed_ratios", [1.0]) or [1.0]]
+    densities = [int(d) for d in ctx.attr("densities")]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr("step_w", 0.0)) or IW / W
+    step_h = float(ctx.attr("step_h", 0.0)) or IH / H
+    offset = float(ctx.attr("offset", 0.5))
+    clip = bool(ctx.attr("clip", False))
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    boxes_per_cell = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = size / density
+            for di in range(density):
+                for dj in range(density):
+                    sx = -size / 2.0 + shift / 2.0 + dj * shift
+                    sy = -size / 2.0 + shift / 2.0 + di * shift
+                    boxes_per_cell.append((sx, sy, bw, bh))
+    out = []
+    for (sx, sy, bw, bh) in boxes_per_cell:
+        bx = jnp.broadcast_to((cx + sx)[None, :], (H, W))
+        by = jnp.broadcast_to((cy + sy)[:, None], (H, W))
+        x1 = (bx - bw / 2) / IW
+        y1 = (by - bh / 2) / IH
+        x2 = (bx + bw / 2) / IW
+        y2 = (by + bh / 2) / IH
+        out.append(jnp.stack([x1, y1, x2, y2], axis=-1))
+    boxes = jnp.stack(out, axis=2)  # [H, W, A, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("generate_proposals", grad="none")
+def generate_proposals(ctx: ExecContext):
+    """RPN proposal generation (reference detection/generate_proposals_op.cc):
+    decode anchors with deltas, clip to image, filter tiny boxes, take
+    pre_nms_topN by score, greedy-NMS, emit post_nms_topN [*, 4] proposals
+    (fixed-shape: invalid slots carry zero boxes/scores)."""
+    scores = ctx.input("Scores")     # [N, A, H, W]
+    deltas = ctx.input("BboxDeltas")  # [N, A*4, H, W]
+    im_info = ctx.input("ImInfo").astype(jnp.float32)  # [N, 3] (h, w, scale)
+    anchors = ctx.input("Anchors").reshape(-1, 4).astype(jnp.float32)
+    variances = ctx.input("Variances").reshape(-1, 4).astype(jnp.float32)
+    pre_n = int(ctx.attr("pre_nms_topN", 6000))
+    post_n = int(ctx.attr("post_nms_topN", 1000))
+    nms_thresh = float(ctx.attr("nms_thresh", 0.5))
+    min_size = float(ctx.attr("min_size", 0.1))
+
+    N, A, H, W = scores.shape
+    K = A * H * W
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, K).astype(jnp.float32)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2).reshape(N, K, 4)
+
+    # Anchors [H, W, A, 4] flattened row-major matches the [H, W, A] score
+    # layout produced by the transpose above
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    var = variances
+
+    def one(sc_i, dl_i, info):
+        cx = var[:, 0] * dl_i[:, 0] * aw + acx
+        cy = var[:, 1] * dl_i[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(var[:, 2] * dl_i[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(var[:, 3] * dl_i[:, 3], 10.0)) * ah
+        x1 = jnp.clip(cx - w / 2, 0, info[1] - 1)
+        y1 = jnp.clip(cy - h / 2, 0, info[0] - 1)
+        x2 = jnp.clip(cx + w / 2, 0, info[1] - 1)
+        y2 = jnp.clip(cy + h / 2, 0, info[0] - 1)
+        keep = ((x2 - x1 + 1 >= min_size * info[2])
+                & (y2 - y1 + 1 >= min_size * info[2]))
+        s = jnp.where(keep, sc_i, -jnp.inf)
+        k = min(pre_n, K)
+        top_s, top_i = jax.lax.top_k(s, k)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[top_i]
+
+        def iou(b, bs):
+            xx1 = jnp.maximum(b[0], bs[:, 0])
+            yy1 = jnp.maximum(b[1], bs[:, 1])
+            xx2 = jnp.minimum(b[2], bs[:, 2])
+            yy2 = jnp.minimum(b[3], bs[:, 3])
+            inter = (jnp.maximum(xx2 - xx1 + 1, 0)
+                     * jnp.maximum(yy2 - yy1 + 1, 0))
+            a1 = (b[2] - b[0] + 1) * (b[3] - b[1] + 1)
+            a2 = (bs[:, 2] - bs[:, 0] + 1) * (bs[:, 3] - bs[:, 1] + 1)
+            return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+        def nms_step(carry, i):
+            alive, n_kept, out_b, out_s = carry
+            ok = alive[i] & (top_s[i] > -jnp.inf) & (n_kept < post_n)
+            out_b = jnp.where(ok, out_b.at[n_kept].set(boxes[i]), out_b)
+            out_s = jnp.where(ok, out_s.at[n_kept].set(top_s[i]), out_s)
+            sup = iou(boxes[i], boxes) > nms_thresh
+            alive = jnp.where(ok, alive & ~sup, alive)
+            n_kept = n_kept + ok.astype(jnp.int32)
+            return (alive, n_kept, out_b, out_s), None
+
+        init = (jnp.ones(k, bool), jnp.int32(0),
+                jnp.zeros((post_n, 4), jnp.float32),
+                jnp.zeros((post_n,), jnp.float32))
+        (_, n_kept, out_b, out_s), _ = jax.lax.scan(
+            nms_step, init, jnp.arange(k))
+        return out_b, out_s, n_kept
+
+    rois, probs, counts = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None],
+            "RpnRoisNum": counts}

@@ -261,3 +261,89 @@ def beam_search_decode(ctx: ExecContext):
     final_scores = scores[-1].reshape(-1)
     return {"SentenceIds": out.astype(jnp.int64),
             "SentenceScores": final_scores}
+
+
+@register_op("sequence_slice")
+def sequence_slice(ctx: ExecContext):
+    """Per-instance sub-sequence (reference sequence_ops/sequence_slice_op.*):
+    X [B, T, ...] + Offset [B] + Length [B] -> Out [B, T, ...] where row b
+    holds X[b, off_b : off_b + len_b] left-aligned, zero-padded; OutLength
+    carries len_b. LoD -> padded redesign: T stays static, the per-row gather
+    uses a shifted iota."""
+    x = ctx.input("X")
+    off = ctx.input("Offset").reshape(-1).astype(jnp.int32)
+    ln = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]          # [1, T]
+    src = jnp.clip(t + off[:, None], 0, T - 1)           # [B, T]
+    gathered = x[jnp.arange(B)[:, None], src]            # any trailing dims
+    mask = (t < ln[:, None])
+    mshape = mask.shape + (1,) * (x.ndim - 2)
+    out = jnp.where(mask.reshape(mshape), gathered, jnp.zeros_like(gathered))
+    return {"Out": out, "OutLength": ln.astype(jnp.int64)}
+
+
+@register_op("sequence_erase", grad="none")
+def sequence_erase(ctx: ExecContext):
+    """Remove listed tokens, shift survivors left (reference
+    sequence_ops/sequence_erase_op.*): X [B, T] int + Length [B] ->
+    Out [B, T] zero-padded + OutLength. The data-dependent compaction is a
+    cumsum-scatter (static shapes)."""
+    x = ctx.input("X")
+    ln = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    tokens = [int(t) for t in ctx.attr("tokens", [])]
+    B, T = x.shape
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = t < ln[:, None]
+    keep = valid
+    for tok in tokens:
+        keep = keep & (x != tok)
+    # destination position of each kept element = exclusive cumsum of keeps
+    dst = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.zeros_like(x)
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    # scatter kept values; dropped ones write to a trash slot then zeroed
+    dst_safe = jnp.where(keep, dst, T - 1)
+    # each kept element has a UNIQUE destination (exclusive cumsum) and
+    # trash writes land past out_len, so .set is exact — .at[].max against a
+    # zero buffer would erase kept NEGATIVE values
+    out = out.at[b_idx, dst_safe].set(jnp.where(keep, x, jnp.zeros_like(x)))
+    # re-zero anything past the new length (trash writes land there)
+    out = jnp.where(t < out_len[:, None], out, jnp.zeros_like(out))
+    return {"Out": out, "OutLength": out_len.astype(jnp.int64)}
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(ctx: ExecContext):
+    """reference sequence_ops/sequence_expand_as_op.*: tile each row of X to
+    the matching row-count of Y. Padding redesign: Y's batch is a multiple
+    of X's; each X row repeats (B_y / B_x) times."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    bx, by = x.shape[0], y.shape[0]
+    if by % bx:
+        raise ValueError(
+            f"sequence_expand_as: Y batch {by} not a multiple of X batch {bx}")
+    return {"Out": jnp.repeat(x, by // bx, axis=0)}
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(ctx: ExecContext):
+    """reference sequence_ops/sequence_scatter_op.*: X [B, T] updated at
+    per-row positions Ids [B, S] with Updates [B, S] (add-scatter, the
+    reference's overwrite-within-sequence becomes accumulate — duplicates in
+    Ids are the caller's contract); IdsLength masks trailing padding."""
+    x = ctx.input("X")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    upd = ctx.input("Updates")
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    if upd.ndim == 3 and upd.shape[-1] == 1 and x.ndim == 2:
+        upd = upd.reshape(upd.shape[:-1])
+    B, S = ids.shape
+    if ctx.has_input("IdsLength"):
+        ln = ctx.input("IdsLength").reshape(-1).astype(jnp.int32)
+        m = jnp.arange(S, dtype=jnp.int32)[None, :] < ln[:, None]
+        upd = jnp.where(m, upd, jnp.zeros_like(upd))
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
+    return {"Out": x.at[b_idx, ids].add(upd)}

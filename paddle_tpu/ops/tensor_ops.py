@@ -16,7 +16,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.types import np_dtype
-from .registry import ExecContext, register_op
+from .registry import (
+    ExecContext,
+    get_op_def,
+    register_grad_compute,
+    register_op,
+)
 
 
 @register_op("fill_constant", grad="none")
@@ -360,3 +365,61 @@ def piecewise_decay(ctx: ExecContext):
     values = jnp.asarray(ctx.attr("values"), jnp.float32)
     idx = jnp.searchsorted(bounds, jnp.reshape(step, ()), side="right")
     return {"Out": jnp.reshape(values[idx], (1,))}
+
+
+def _print_value(ctx, x, phase_tag=""):
+    # the first_n counter lives ON the Operator object: stable across
+    # program rebuilds (an id()-keyed module dict would leak and could
+    # inherit a dead op's exhausted count after id reuse)
+    count = getattr(ctx.op, "_print_count", 0)
+    first_n = int(ctx.attr("first_n", -1))
+    if first_n < 0 or count < first_n:
+        ctx.op._print_count = count + 1
+        msg = ctx.attr("message", "") or ""
+        arr = np.asarray(x)
+        summarize = int(ctx.attr("summarize", 20))
+        flat = arr.reshape(-1)
+        shown = flat if summarize < 0 else flat[:summarize]
+        print(f"{msg}{phase_tag}  shape={arr.shape} dtype={arr.dtype} "
+              f"values={np.array2string(shown, precision=6)}", flush=True)
+
+
+@register_op("print", host=True)
+def print_op(ctx: ExecContext):
+    """In-graph tensor printing (reference operators/print_op.cc): a host op
+    that logs the value and passes it through unchanged, honoring
+    first_n/message/summarize. NOTE: host ops split the jit and cannot run
+    under a device mesh (use jax.debug.print inside custom ops for
+    mesh-compatible tracing)."""
+    x = ctx.input("In")
+    if ctx.attr("print_phase", "both") in ("forward", "both"):
+        _print_value(ctx, x)
+    return {"Out": x}
+
+
+@register_grad_compute("print")
+def print_grad(ctx: ExecContext):
+    """Identity gradient + optional backward-phase printing (reference
+    print_op.cc PrintOpGradientMaker: the grad of print is print of grad)."""
+    g = ctx.input("Out@GRAD")
+    if ctx.attr("print_phase", "both") in ("backward", "both"):
+        _print_value(ctx, g, phase_tag=" [backward]")
+    return {"In@GRAD": g}
+
+
+def _print_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    x = op.input("In")[0]
+    if x in no_grad_set:
+        return []
+    return [{
+        "type": "print_grad",
+        "inputs": {"Out@GRAD": [grad_var_name(op.output("Out")[0])]},
+        "outputs": {"In@GRAD": [grad_var_name(x)]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+get_op_def("print").grad_maker = _print_grad_maker
+get_op_def("print_grad").host = True
