@@ -25,6 +25,7 @@ from . import contrib  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import transpiler  # noqa: F401
+from . import debugger  # noqa: F401
 from . import distributed  # noqa: F401
 from . import inference  # noqa: F401
 from . import dygraph  # noqa: F401

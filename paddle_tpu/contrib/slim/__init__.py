@@ -1,2 +1,7 @@
-"""Model-compression toolkit (reference python/paddle/fluid/contrib/slim/)."""
+"""Model-compression toolkit (reference python/paddle/fluid/contrib/slim/):
+quantization (QAT/PTQ/freeze/int8), magnitude pruning, distillation losses.
+Light-NAS is out of scope (the reference's evolutionary searcher is an
+experiment driver, not a framework capability)."""
+from . import distillation  # noqa: F401
+from . import prune  # noqa: F401
 from . import quantization  # noqa: F401

@@ -1,0 +1,109 @@
+"""Magnitude pruning: one-shot weight masking + mask re-application during
+training, and a loss-sensitivity sweep to pick per-parameter ratios.
+
+TPU-native re-design of the reference's pruning strategies
+(/root/reference/python/paddle/fluid/contrib/slim/prune/:
+prune_strategy.py SensitivePruneStrategy, pruner.py StructurePruner): the
+reference prunes whole filters through a graph wrapper; here the same two
+ingredients operate on the Program IR directly —
+
+  * `MagnitudePruner.prune_weights` zeroes the lowest-|w| entries (or whole
+    output columns/filters in structured mode) and stores a persistable
+    `<p>@prune_mask` in the scope;
+  * `MagnitudePruner.apply` additionally appends `p = p * mask` after the
+    program's optimizer ops, so SGD steps cannot resurrect pruned weights —
+    the reference's "mask backward" trick expressed as a program transform
+    (XLA fuses the multiply into the update);
+  * `sensitivity` measures eval-metric degradation per (param, ratio) — the
+    reference's SensitivePruneStrategy probe — so callers can budget ratios.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework import default_main_program
+
+__all__ = ["MagnitudePruner", "sensitivity"]
+
+
+class MagnitudePruner:
+    def __init__(self, structured: bool = False):
+        # structured=True prunes whole output columns (axis -1 groups, the
+        # fc/conv filter analogue) by their L2 norm; False prunes elements
+        self.structured = structured
+
+    def _mask(self, w: np.ndarray, ratio: float) -> np.ndarray:
+        if ratio <= 0:
+            return np.ones_like(w, dtype=np.float32)
+        # rank-based selection prunes EXACTLY k entries: a magnitude
+        # threshold would overshoot on ties (e.g. many exact zeros, or a
+        # constant tensor pruning to nothing)
+        if self.structured and w.ndim >= 2:
+            norms = np.sqrt((w.astype(np.float64) ** 2).reshape(
+                -1, w.shape[-1]).sum(axis=0))
+            k = int(np.floor(ratio * norms.size))
+            if k == 0:
+                return np.ones_like(w, dtype=np.float32)
+            col_mask = np.ones(norms.size, np.float32)
+            col_mask[np.argpartition(norms, k - 1)[:k]] = 0.0
+            return np.broadcast_to(col_mask, w.shape).astype(np.float32)
+        flat = np.abs(w).reshape(-1)
+        k = int(np.floor(ratio * flat.size))
+        if k == 0:
+            return np.ones_like(w, dtype=np.float32)
+        mask = np.ones(flat.size, np.float32)
+        mask[np.argpartition(flat, k - 1)[:k]] = 0.0
+        return mask.reshape(w.shape)
+
+    def prune_weights(self, scope, params, ratios) -> dict:
+        """Zero the masked entries in the SCOPE; returns {param: mask}.
+        `ratios` is a float (uniform) or {param: float}."""
+        masks = {}
+        for p in params:
+            r = ratios[p] if isinstance(ratios, dict) else float(ratios)
+            w = np.asarray(scope.find_var(p))
+            m = self._mask(w, r)
+            scope.set_var(p, (w * m).astype(w.dtype))
+            scope.set_var(p + "@prune_mask", m)
+            masks[p] = m
+        return masks
+
+    def apply(self, params, ratios, scope=None, program=None):
+        """prune_weights + keep-pruned-through-training: appends
+        `p = elementwise_mul(p, mask)` ops AFTER the existing program ops
+        (i.e. after the optimizer update), so each step re-zeroes."""
+        from ...executor import global_scope
+
+        scope = scope or global_scope()
+        program = program or default_main_program()
+        masks = self.prune_weights(scope, params, ratios)
+        block = program.global_block
+        for p in params:
+            mname = p + "@prune_mask"
+            if not block.has_var(mname):
+                v = block.var(p)
+                block.create_var(name=mname, shape=v.shape, dtype="float32",
+                                 persistable=True)
+            block.append_op("elementwise_mul", {"X": [p], "Y": [mname]},
+                            {"Out": [p]}, {"axis": -1})
+        program._bump_version()
+        return masks
+
+
+def sensitivity(program, scope, exe, params, eval_fn, ratios=(0.1, 0.3, 0.5),
+                pruner: MagnitudePruner | None = None) -> dict:
+    """Per-(param, ratio) eval degradation (reference
+    SensitivePruneStrategy's sensitivity probe): prunes ONE param at a time
+    in a scratch copy of its value, calls `eval_fn() -> float` (higher =
+    better), restores, returns {param: {ratio: metric}}."""
+    pruner = pruner or MagnitudePruner()
+    out: dict = {}
+    for p in params:
+        orig = np.asarray(scope.find_var(p)).copy()
+        out[p] = {}
+        for r in ratios:
+            m = pruner._mask(orig, float(r))
+            scope.set_var(p, (orig * m).astype(orig.dtype))
+            out[p][float(r)] = float(eval_fn())
+        scope.set_var(p, orig)
+    return out

@@ -140,7 +140,7 @@ class VarBase:
     def __repr__(self):
         return f"VarBase(name={self.name}, shape={self.shape})"
 
-    # arithmetic sugar (reference math_op_patch)
+    # arithmetic sugar (reference dygraph/math_op_patch.py monkey_patch)
     def __add__(self, o):
         return _dy_op("elementwise_add", {"X": [self], "Y": [_lift(o)]})["Out"]
 
@@ -149,6 +149,50 @@ class VarBase:
 
     def __mul__(self, o):
         return _dy_op("elementwise_mul", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def __truediv__(self, o):
+        return _dy_op("elementwise_div", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def __pow__(self, o):
+        return _dy_op("elementwise_pow", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def __neg__(self):
+        return _dy_op("scale", {"X": [self]}, attrs={"scale": -1.0})["Out"]
+
+    def __matmul__(self, o):
+        return _dy_op("matmul", {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def _lift_full(self, o) -> "VarBase":
+        """Scalar operands on the LEFT must broadcast UP to self's shape
+        (the reference elementwise rule requires rank(Y) <= rank(X))."""
+        if isinstance(o, VarBase):
+            return o
+        arr = jnp.broadcast_to(jnp.asarray(o, self._value.dtype),
+                               self._value.shape)
+        return VarBase(arr, stop_gradient=True)
+
+    def __rsub__(self, o):
+        return _dy_op("elementwise_sub",
+                      {"X": [self._lift_full(o)], "Y": [self]})["Out"]
+
+    def __rtruediv__(self, o):
+        return _dy_op("elementwise_div",
+                      {"X": [self._lift_full(o)], "Y": [self]})["Out"]
+
+    def _cmp(self, o, op_type):
+        return _dy_op(op_type, {"X": [self], "Y": [_lift(o)]})["Out"]
+
+    def __lt__(self, o):
+        return self._cmp(o, "less_than")
+
+    def __le__(self, o):
+        return self._cmp(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._cmp(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._cmp(o, "greater_equal")
 
     __radd__ = __add__
     __rmul__ = __mul__

@@ -17,6 +17,59 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "Print",
+    "elu",
+    "relu6",
+    "hard_sigmoid",
+    "hard_swish",
+    "swish",
+    "brelu",
+    "soft_relu",
+    "stanh",
+    "selu",
+    "sign",
+    "elementwise_mod",
+    "elementwise_floordiv",
+    "reduce_all",
+    "reduce_any",
+    "gather_nd",
+    "scatter_nd_add",
+    "scatter_nd",
+    "sum",
+    "rank",
+    "size",
+    "huber_loss",
+    "log_loss",
+    "kldiv_loss",
+    "rank_loss",
+    "margin_rank_loss",
+    "bpr_loss",
+    "dice_loss",
+    "mean_iou",
+    "resize_bilinear",
+    "resize_nearest",
+    "image_resize",
+    "adaptive_pool2d",
+    "pool3d",
+    "conv3d",
+    "pixel_shuffle",
+    "shuffle_channel",
+    "space_to_depth",
+    "temporal_shift",
+    "maxout",
+    "lrn",
+    "affine_channel",
+    "multiplex",
+    "crop",
+    "pad_constant_like",
+    "unfold",
+    "grid_sampler",
+    "bilinear_tensor_product",
+    "shard_index",
+    "sampling_id",
+    "roi_align",
+    "roi_pool",
+    "fsp_matrix",
+    "add_position_encoding",
     "fused_attention",
     "ring_attention",
     "nce",
@@ -1145,3 +1198,390 @@ def Print(input, first_n=-1, message=None, summarize=20,
          "summarize": summarize,
          "print_phase": print_phase})
     return out
+
+
+# ---------------------------------------------------------------------------
+# long-tail layer wrappers (reference nn.py parity; ops in
+# activation_ops / math_ops / tensor_ops / vision_ops / detection_ops)
+# ---------------------------------------------------------------------------
+
+
+def _simple_op(op_type, inputs, attrs=None, out_slot="Out", dtype=None,
+               n_out=1):
+    helper = LayerHelper(op_type)
+    first = next(v for vs in inputs.values() for v in vs)
+    outs = [helper.create_variable_for_type_inference(dtype or first.dtype)
+            for _ in range(n_out)]
+    helper.append_op(op_type, inputs,
+                     {out_slot: [outs[0]]} if n_out == 1 else
+                     {s: [o] for s, o in zip(out_slot, outs)},
+                     attrs or {})
+    return outs[0] if n_out == 1 else outs
+
+
+def elu(x, alpha=1.0, name=None):
+    return _simple_op("elu", {"X": [x]}, {"alpha": alpha})
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _simple_op("relu6", {"X": [x]})
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _simple_op("hard_sigmoid", {"X": [x]},
+                      {"slope": slope, "offset": offset})
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _simple_op("hard_swish", {"X": [x]})
+
+
+def swish(x, beta=1.0, name=None):
+    return _simple_op("swish", {"X": [x]}, {"beta": beta})
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _simple_op("brelu", {"X": [x]}, {"t_min": t_min, "t_max": t_max})
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _simple_op("soft_relu", {"X": [x]}, {"threshold": threshold})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _simple_op("stanh", {"X": [x]},
+                      {"scale_a": scale_a, "scale_b": scale_b})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _simple_op("selu", {"X": [x]}, {"scale": scale, "alpha": alpha})
+
+
+def sign(x, name=None):
+    return _simple_op("sign", {"X": [x]})
+
+
+def elementwise_mod(x, y, axis=-1, name=None):
+    return _simple_op("elementwise_mod", {"X": [x], "Y": [y]}, {"axis": axis})
+
+
+def elementwise_floordiv(x, y, axis=-1, name=None):
+    return _simple_op("elementwise_floordiv", {"X": [x], "Y": [y]},
+                      {"axis": axis})
+
+
+def reduce_all(x, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", x, dim, keep_dim, name)
+
+
+def reduce_any(x, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", x, dim, keep_dim, name)
+
+
+def gather_nd(input, index, name=None):
+    return _simple_op("gather_nd", {"X": [input], "Index": [index]})
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _simple_op("scatter_nd_add",
+                      {"X": [ref], "Index": [index], "Updates": [updates]})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _simple_op("scatter_nd", {"Index": [index], "Updates": [updates]},
+                      {"shape": list(shape)}, dtype=updates.dtype)
+
+
+def sum(x, name=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return _simple_op("sum", {"X": list(xs)})
+
+
+def rank(input):
+    """Static rank as a constant tensor (reference nn.py rank)."""
+    from .tensor import fill_constant
+
+    return fill_constant(shape=[1], dtype="int32", value=len(input.shape))
+
+
+def size(input):
+    """Element count at RUNTIME (reference nn.py size): the batch dim is -1
+    at build time, so the product must come from the executed shape."""
+    shp = _simple_op("shape", {"X": [input]}, dtype="int32")
+    shp.shape = (len(input.shape),)
+    return _reduce("reduce_prod", cast(shp, "int64"), None, False, None)
+
+
+def huber_loss(input, label, delta):
+    return _simple_op("huber_loss", {"X": [input], "Y": [label]},
+                      {"delta": delta})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _simple_op("log_loss", {"Predicted": [input], "Labels": [label]},
+                      {"epsilon": epsilon}, out_slot="Loss")
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return _simple_op("kldiv_loss", {"X": [x], "Target": [target]},
+                      {"reduction": reduction}, out_slot="Loss")
+
+
+def rank_loss(label, left, right, name=None):
+    return _simple_op("rank_loss",
+                      {"Label": [label], "Left": [left], "Right": [right]})
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss")
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op("margin_rank_loss",
+                     {"Label": [label], "X1": [left], "X2": [right]},
+                     {"Out": [out], "Activated": [act]}, {"margin": margin})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    return _simple_op("bpr_loss", {"X": [input], "Label": [label]},
+                      out_slot="Y")
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference nn.py dice_loss — built from primitives (no bespoke op)."""
+    label_f = cast(label, input.dtype)
+    inter = reduce_sum(elementwise_mul(input, label_f))
+    union = reduce_sum(input) + reduce_sum(label_f)
+    from .tensor import fill_constant
+
+    one = fill_constant(shape=[], dtype=input.dtype, value=1.0)
+    eps = fill_constant(shape=[], dtype=input.dtype, value=epsilon)
+    return one - elementwise_div(
+        scale(inter, scale=2.0), elementwise_add(union, eps))
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("float32")
+    correct = helper.create_variable_for_type_inference("float32")
+    helper.append_op("mean_iou",
+                     {"Predictions": [input], "Labels": [label]},
+                     {"OutMeanIou": [miou], "OutWrong": [wrong],
+                      "OutCorrect": [correct]}, {"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=False, align_mode=1):
+    oh, ow = (out_shape or (0, 0))
+    return _simple_op("bilinear_interp", {"X": [input]},
+                      {"out_h": oh, "out_w": ow, "scale": scale or 0.0})
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=False):
+    oh, ow = (out_shape or (0, 0))
+    return _simple_op("nearest_interp", {"X": [input]},
+                      {"out_h": oh, "out_w": ow, "scale": scale or 0.0})
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None,
+                 align_corners=False, align_mode=1):
+    if resample.upper() == "NEAREST":
+        return resize_nearest(input, out_shape, scale, name)
+    return resize_bilinear(input, out_shape, scale, name)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    return _simple_op("adaptive_pool2d", {"X": [input]},
+                      {"pooled_size": list(pool_size),
+                       "pooling_type": pool_type})
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None, **kw):
+    def _trip(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    return _simple_op("pool3d", {"X": [input]},
+                      {"ksize": _trip(pool_size), "pooling_type": pool_type,
+                       "strides": _trip(pool_stride),
+                       "paddings": _trip(pool_padding),
+                       "global_pooling": global_pooling})
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, name=None, act=None,
+           **kw):
+    def _trip(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    helper = LayerHelper("conv3d", name=name)
+    C = input.shape[1]
+    fs = _trip(filter_size)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[num_filters, C // groups] + fs,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d", {"Input": [input], "Filter": [w]},
+                     {"Output": [out]},
+                     {"strides": _trip(stride), "paddings": _trip(padding),
+                      "dilations": _trip(dilation), "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", {"X": [out], "Y": [b]},
+                         {"Out": [out2]}, {"axis": 1})
+        out = out2
+    return helper.append_activation(out, act) if hasattr(
+        helper, "append_activation") else (
+        _simple_op(act, {"X": [out]}) if act else out)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _simple_op("pixel_shuffle", {"X": [x]},
+                      {"upscale_factor": upscale_factor})
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple_op("shuffle_channel", {"X": [x]}, {"group": group})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple_op("space_to_depth", {"X": [x]}, {"blocksize": blocksize})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _simple_op("temporal_shift", {"X": [x]},
+                      {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def maxout(x, groups, name=None):
+    return _simple_op("maxout", {"X": [x]}, {"groups": groups})
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("lrn", {"X": [input]},
+                     {"Out": [out], "MidOut": [mid]},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    out = _simple_op("affine_channel",
+                     {"X": [x], "Scale": [scale], "Bias": [bias]})
+    return _simple_op(act, {"X": [out]}) if act else out
+
+
+def multiplex(inputs, index):
+    return _simple_op("multiplex", {"X": list(inputs), "Ids": [index]},
+                      dtype=inputs[0].dtype)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return _simple_op("crop", {"X": [x]},
+                      {"shape": list(shape),
+                       "offsets": list(offsets or [0] * len(shape))})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple_op("pad_constant_like", {"X": [x], "Y": [y]},
+                      {"pad_value": pad_value}, dtype=y.dtype)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair_(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+    return _simple_op("unfold", {"X": [x]},
+                      {"kernel_sizes": _pair_(kernel_sizes),
+                       "strides": _pair_(strides),
+                       "paddings": _pair_(paddings),
+                       "dilations": _pair_(dilations)}, out_slot="Y")
+
+
+def grid_sampler(x, grid, name=None):
+    return _simple_op("grid_sampler", {"X": [x], "Grid": [grid]},
+                      out_slot="Output")
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    w = helper.create_parameter(
+        attr=param_attr, shape=[size, x.shape[-1], y.shape[-1]],
+        dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=bias_attr, shape=[1, size],
+                                    dtype=x.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = _simple_op("bilinear_tensor_product", inputs)
+    return _simple_op(act, {"X": [out]}) if act else out
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _simple_op("shard_index", {"X": [input]},
+                      {"index_num": index_num, "nshards": nshards,
+                       "shard_id": shard_id, "ignore_value": ignore_value})
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    return _simple_op("sampling_id", {"X": [x]}, dtype="int64")
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_batch_id=None):
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    return _simple_op("roi_align", inputs,
+                      {"pooled_height": pooled_height,
+                       "pooled_width": pooled_width,
+                       "spatial_scale": spatial_scale,
+                       "sampling_ratio": sampling_ratio})
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch_id=None):
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoisBatchId"] = [rois_batch_id]
+    return _simple_op("roi_pool", inputs,
+                      {"pooled_height": pooled_height,
+                       "pooled_width": pooled_width,
+                       "spatial_scale": spatial_scale})
+
+
+def fsp_matrix(x, y):
+    from ..contrib.slim.distillation import fsp_matrix as _fsp
+
+    return _fsp(x, y)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """reference nn.py add_position_encoding: sinusoid table added to
+    [B, T, D] — built from primitives."""
+    import numpy as _np
+
+    from .tensor import assign
+
+    B_, T, D = -1, input.shape[1], input.shape[2]
+    pos = _np.arange(T)[:, None]
+    i = _np.arange(D // 2)[None, :]
+    angle = pos / _np.power(10000.0, 2.0 * i / D)
+    table = _np.zeros((T, D), _np.float32)
+    table[:, 0::2] = _np.sin(angle)
+    table[:, 1::2] = _np.cos(angle)
+    enc = assign(table)
+    return elementwise_add(scale(input, scale=alpha),
+                           scale(enc, scale=beta))

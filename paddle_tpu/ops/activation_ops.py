@@ -105,3 +105,11 @@ def stanh(ctx: ExecContext):
     a = jnp.asarray(ctx.attr("scale_a", 2.0 / 3.0), x.dtype)
     b = jnp.asarray(ctx.attr("scale_b", 1.7159), x.dtype)
     return {"Out": b * jnp.tanh(a * x)}
+
+
+@register_op("selu")
+def selu(ctx: ExecContext):
+    x = ctx.input("X")
+    scale = jnp.asarray(ctx.attr("scale", 1.0507009873554805), x.dtype)
+    alpha = jnp.asarray(ctx.attr("alpha", 1.6732632423543772), x.dtype)
+    return {"Out": scale * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))}

@@ -501,15 +501,24 @@ def anchor_generator(ctx: ExecContext):
     offset = float(ctx.attr("offset", 0.5))
     H, W = feat.shape[2], feat.shape[3]
 
+    # reference anchor_generator_op.h:55-81: rounded ratio-base sizes,
+    # centers at idx*stride + offset*(stride-1), inclusive-pixel corners
+    # spanning ±(w-1)/2 so that x2-x1+1 == anchor_width
     base = []
+    area = stride[0] * stride[1]
     for r in ratios:
+        base_w = round(np.sqrt(area / r))
+        base_h = round(base_w * r)
         for s in sizes:
-            w = s * np.sqrt(1.0 / r)
-            h = s * np.sqrt(r)
-            base.append((-w / 2, -h / 2, w / 2, h / 2))
+            w = s / stride[0] * base_w
+            h = s / stride[1] * base_h
+            base.append((-0.5 * (w - 1), -0.5 * (h - 1),
+                         0.5 * (w - 1), 0.5 * (h - 1)))
     base = jnp.asarray(base, jnp.float32)               # [A, 4]
-    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
-    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cx = (jnp.arange(W, dtype=jnp.float32) * stride[0]
+          + offset * (stride[0] - 1))
+    cy = (jnp.arange(H, dtype=jnp.float32) * stride[1]
+          + offset * (stride[1] - 1))
     centers = jnp.stack(
         [*jnp.meshgrid(cx, cy, indexing="xy")], axis=-1)  # [H, W, 2]
     ctr = jnp.concatenate([centers, centers], axis=-1)    # [H, W, 4]
@@ -539,7 +548,9 @@ def bipartite_match(ctx: ExecContext):
                                -jnp.inf, m)
             flat = jnp.argmax(masked)
             r, c = flat // C, flat % C
-            valid = masked[r, c] > -jnp.inf
+            # reference kEPS guard: a zero/near-zero distance is NOT a match
+            # (bipartite_match_op.cc:115) — those columns stay unmatched
+            valid = masked[r, c] > 1e-6
             out_idx = jnp.where(valid, out_idx.at[c].set(r), out_idx)
             out_d = jnp.where(valid, out_d.at[c].set(m[r, c]), out_d)
             row_used = jnp.where(valid, row_used.at[r].set(True), row_used)
@@ -618,7 +629,9 @@ def generate_proposals(ctx: ExecContext):
     pre_n = int(ctx.attr("pre_nms_topN", 6000))
     post_n = int(ctx.attr("post_nms_topN", 1000))
     nms_thresh = float(ctx.attr("nms_thresh", 0.5))
-    min_size = float(ctx.attr("min_size", 0.1))
+    # reference FilterBoxes floors min_size at 1 pixel
+    min_size = max(float(ctx.attr("min_size", 0.1)), 1.0)
+    bbox_clip = float(np.log(1000.0 / 16.0))  # reference kBBoxClipDefault
 
     N, A, H, W = scores.shape
     K = A * H * W
@@ -636,14 +649,18 @@ def generate_proposals(ctx: ExecContext):
     def one(sc_i, dl_i, info):
         cx = var[:, 0] * dl_i[:, 0] * aw + acx
         cy = var[:, 1] * dl_i[:, 1] * ah + acy
-        w = jnp.exp(jnp.minimum(var[:, 2] * dl_i[:, 2], 10.0)) * aw
-        h = jnp.exp(jnp.minimum(var[:, 3] * dl_i[:, 3], 10.0)) * ah
+        w = jnp.exp(jnp.minimum(var[:, 2] * dl_i[:, 2], bbox_clip)) * aw
+        h = jnp.exp(jnp.minimum(var[:, 3] * dl_i[:, 3], bbox_clip)) * ah
         x1 = jnp.clip(cx - w / 2, 0, info[1] - 1)
         y1 = jnp.clip(cy - h / 2, 0, info[0] - 1)
         x2 = jnp.clip(cx + w / 2, 0, info[1] - 1)
         y2 = jnp.clip(cy + h / 2, 0, info[0] - 1)
+        ctr_x = x1 + (x2 - x1 + 1) / 2
+        ctr_y = y1 + (y2 - y1 + 1) / 2
         keep = ((x2 - x1 + 1 >= min_size * info[2])
-                & (y2 - y1 + 1 >= min_size * info[2]))
+                & (y2 - y1 + 1 >= min_size * info[2])
+                # reference FilterBoxes: box CENTER must lie in the image
+                & (ctr_x < info[1]) & (ctr_y < info[0]))
         s = jnp.where(keep, sc_i, -jnp.inf)
         k = min(pre_n, K)
         top_s, top_i = jax.lax.top_k(s, k)

@@ -244,3 +244,74 @@ def pow_op(ctx: ExecContext):
 def isfinite(ctx: ExecContext):
     # reference isfinite_op.cc reduces to a single bool
     return {"Out": jnp.all(jnp.isfinite(ctx.input("X"))).reshape(1)}
+
+
+@register_op("kldiv_loss")
+def kldiv_loss(ctx: ExecContext):
+    """reference kldiv_loss_op.*: target * (log(target) - input), input is
+    LOG-probabilities; reduction applied by the layer."""
+    x, t = ctx.input("X"), ctx.input("Target")
+    loss = t * (jnp.log(jnp.maximum(t, 1e-10)) - x)
+    red = ctx.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": loss}
+
+
+@register_op("rank_loss")
+def rank_loss(ctx: ExecContext):
+    """reference rank_loss_op.*: RankNet pairwise loss."""
+    label = ctx.input("Label")
+    left, right = ctx.input("Left"), ctx.input("Right")
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ctx: ExecContext):
+    """reference margin_rank_loss_op.*: max(0, -label*(x1-x2)+margin)."""
+    label = ctx.input("Label")
+    x1, x2 = ctx.input("X1"), ctx.input("X2")
+    margin = float(ctx.attr("margin", 0.0))
+    out = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("bpr_loss")
+def bpr_loss(ctx: ExecContext):
+    """reference bpr_loss_op.*: Bayesian personalized ranking over logits
+    [B, C] with positive-label column [B, 1]."""
+    x, label = ctx.input("X"), ctx.input("Label")
+    lbl = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, lbl[:, None], axis=1)
+    diff = pos - x  # [B, C]
+    lse = -jnp.log(jax.nn.sigmoid(diff) + 1e-10)
+    C = x.shape[1]
+    mask = jax.nn.one_hot(lbl, C, dtype=x.dtype)
+    out = (lse * (1 - mask)).sum(axis=1, keepdims=True) / (C - 1)
+    return {"Y": out}
+
+
+@register_op("mean_iou", grad="none")
+def mean_iou(ctx: ExecContext):
+    """reference mean_iou_op.*: mean intersection-over-union across classes."""
+    pred = ctx.input("Predictions").reshape(-1).astype(jnp.int32)
+    label = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    n = int(ctx.attr("num_classes"))
+    inter = jnp.zeros((n,), jnp.float32).at[pred].add(
+        (pred == label).astype(jnp.float32))
+    pred_c = jnp.zeros((n,), jnp.float32).at[pred].add(1.0)
+    lbl_c = jnp.zeros((n,), jnp.float32).at[label].add(1.0)
+    union = pred_c + lbl_c - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+    # reference mean_iou_op.h:96-98 increments wrong for BOTH the predicted
+    # and the label class on a mismatch, so wrong + correct == union
+    return {"OutMeanIou": miou,
+            "OutWrong": (pred_c - inter) + (lbl_c - inter),
+            "OutCorrect": inter}

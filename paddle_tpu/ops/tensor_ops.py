@@ -423,3 +423,17 @@ def _print_grad_maker(op, block, no_grad_set=frozenset()):
 
 get_op_def("print").grad_maker = _print_grad_maker
 get_op_def("print_grad").host = True
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(ctx: ExecContext):
+    x, idx, upd = ctx.input("X"), ctx.input("Index"), ctx.input("Updates")
+    return {"Out": x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}
+
+
+@register_op("scatter_nd", grad="none")
+def scatter_nd(ctx: ExecContext):
+    idx, upd = ctx.input("Index"), ctx.input("Updates")
+    shape = [int(s) for s in ctx.attr("shape")]
+    z = jnp.zeros(shape, upd.dtype)
+    return {"Out": z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}

@@ -1,0 +1,298 @@
+"""Vision / layout ops: resize, pooling variants, pixel shuffling, crops.
+
+TPU-native equivalents of the reference operators
+(/root/reference/paddle/fluid/operators/): interpolate_op.* (bilinear /
+nearest resize), pool_op 3-D + adaptive paths, pixel_shuffle_op,
+shuffle_channel_op, space_to_depth_op, temporal_shift_op, maxout_op, lrn_op,
+affine_channel_op, multiplex_op, crop_op, pad_constant_like_op, unfold_op,
+grid_sampler_op, conv3d from conv_op.*. Everything static-shaped jnp/lax;
+grads derive via vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_op
+
+
+def _resize(ctx, method):
+    x = ctx.input("X")  # [N, C, H, W]
+    out_h = int(ctx.attr("out_h", 0))
+    out_w = int(ctx.attr("out_w", 0))
+    scale = float(ctx.attr("scale", 0.0) or 0.0)
+    if out_h <= 0 or out_w <= 0:
+        if scale <= 0:
+            raise ValueError("resize needs out_h/out_w or scale")
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w),
+                           method=method)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx: ExecContext):
+    """reference interpolate_op.* bilinear path (align_corners=False form:
+    jax.image 'linear' half-pixel convention)."""
+    return _resize(ctx, "linear")
+
+
+@register_op("nearest_interp")
+def nearest_interp(ctx: ExecContext):
+    return _resize(ctx, "nearest")
+
+
+@register_op("pool3d")
+def pool3d(ctx: ExecContext):
+    x = ctx.input("X")  # [N, C, D, H, W]
+    ptype = ctx.attr("pooling_type", "max")
+    k = list(ctx.attr("ksize"))
+    s = list(ctx.attr("strides", [1, 1, 1]))
+    p = list(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        k = list(x.shape[2:])
+        s, p = k, [0, 0, 0]
+    window = (1, 1, *k)
+    strides = (1, 1, *s)
+    pads = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                    strides, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                       pads)
+        if ctx.attr("exclusive", True) and any(p):
+            # reference pool_op exclusive=true: padded zeros do not count
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                           jax.lax.add, window, strides,
+                                           pads)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(k))
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("conv3d")
+def conv3d(ctx: ExecContext):
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    s = list(ctx.attr("strides", [1, 1, 1]))
+    p = list(ctx.attr("paddings", [0, 0, 0]))
+    d = list(ctx.attr("dilations", [1, 1, 1]))
+    out = jax.lax.conv_general_dilated(
+        x, w, tuple(s), [(pp, pp) for pp in p], rhs_dilation=tuple(d),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=int(ctx.attr("groups", 1)))
+    return {"Output": out}
+
+
+@register_op("adaptive_pool2d")
+def adaptive_pool2d(ctx: ExecContext):
+    """reference pool_op adaptive=True: output bins partition the input
+    evenly; requires divisible dims (the XLA-static case — the reference's
+    uneven bins need data-dependent windows)."""
+    x = ctx.input("X")
+    oh, ow = [int(v) for v in ctx.attr("pooled_size")]
+    ptype = ctx.attr("pooling_type", "avg")
+    N, C, H, W = x.shape
+    if H % oh or W % ow:
+        raise ValueError(
+            f"adaptive_pool2d: input {H}x{W} not divisible by output "
+            f"{oh}x{ow} (uneven adaptive bins are not static-shaped)")
+    r = x.reshape(N, C, oh, H // oh, ow, W // ow)
+    out = r.max(axis=(3, 5)) if ptype == "max" else r.mean(axis=(3, 5))
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(ctx: ExecContext):
+    x = ctx.input("X")
+    u = int(ctx.attr("upscale_factor"))
+    N, C, H, W = x.shape
+    out = x.reshape(N, C // (u * u), u, u, H, W)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(N, C // (u * u),
+                                                  H * u, W * u)
+    return {"Out": out}
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(ctx: ExecContext):
+    x = ctx.input("X")
+    g = int(ctx.attr("group"))
+    N, C, H, W = x.shape
+    out = x.reshape(N, g, C // g, H, W).transpose(0, 2, 1, 3, 4)
+    return {"Out": out.reshape(N, C, H, W)}
+
+
+@register_op("space_to_depth")
+def space_to_depth(ctx: ExecContext):
+    x = ctx.input("X")
+    b = int(ctx.attr("blocksize"))
+    N, C, H, W = x.shape
+    out = x.reshape(N, C, H // b, b, W // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4).reshape(N, C * b * b, H // b, W // b)
+    return {"Out": out}
+
+
+@register_op("temporal_shift")
+def temporal_shift(ctx: ExecContext):
+    """reference temporal_shift_op.*: [N*T, C, H, W], shift 1/shift_ratio of
+    channels one step back in time, the same share forward, rest static."""
+    x = ctx.input("X")
+    T = int(ctx.attr("seg_num"))
+    ratio = float(ctx.attr("shift_ratio", 0.25))
+    NT, C, H, W = x.shape
+    N = NT // T
+    c1 = int(C * ratio)
+    c2 = int(C * 2 * ratio)
+    xr = x.reshape(N, T, C, H, W)
+    back = jnp.concatenate([xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], 1)
+    fwd = jnp.concatenate([jnp.zeros_like(xr[:, :1, c1:c2]),
+                           xr[:, :-1, c1:c2]], 1)
+    out = jnp.concatenate([back, fwd, xr[:, :, c2:]], axis=2)
+    return {"Out": out.reshape(NT, C, H, W)}
+
+
+@register_op("maxout")
+def maxout(ctx: ExecContext):
+    x = ctx.input("X")
+    g = int(ctx.attr("groups"))
+    N, C, H, W = x.shape
+    return {"Out": x.reshape(N, C // g, g, H, W).max(axis=2)}
+
+
+@register_op("lrn")
+def lrn(ctx: ExecContext):
+    """reference lrn_op.*: local response normalization across channels."""
+    x = ctx.input("X")
+    n = int(ctx.attr("n", 5))
+    k = float(ctx.attr("k", 1.0))
+    alpha = float(ctx.attr("alpha", 1e-4))
+    beta = float(ctx.attr("beta", 0.75))
+    sq = jnp.square(x)
+    half = n // 2
+    pads = ((0, 0), (half, n - 1 - half), (0, 0), (0, 0))
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, (1, n, 1, 1),
+                                (1, 1, 1, 1), pads)
+    mid = (k + alpha * acc) ** beta
+    return {"Out": (x / mid).astype(x.dtype), "MidOut": mid}
+
+
+@register_op("affine_channel")
+def affine_channel(ctx: ExecContext):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    sh = [1, -1] + [1] * (x.ndim - 2)
+    return {"Out": x * scale.reshape(sh) + bias.reshape(sh)}
+
+
+@register_op("multiplex")
+def multiplex(ctx: ExecContext):
+    """reference multiplex_op.*: row-wise select among N input tensors by
+    per-row index."""
+    ids = ctx.input("Ids").reshape(-1).astype(jnp.int32)
+    xs = jnp.stack([x for x in ctx.inputs("X") if x is not None])  # [K,B,...]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("crop")
+def crop(ctx: ExecContext):
+    x = ctx.input("X")
+    shape = [int(s) for s in ctx.attr("shape")]
+    offsets = [int(o) for o in ctx.attr("offsets", [0] * x.ndim)]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": x[idx]}
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ctx: ExecContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    val = float(ctx.attr("pad_value", 0.0))
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=val)}
+
+
+@register_op("unfold")
+def unfold(ctx: ExecContext):
+    """reference unfold_op.* (im2col as an op): [N, C, H, W] ->
+    [N, C*kh*kw, L]."""
+    x = ctx.input("X")
+    kh, kw = [int(v) for v in ctx.attr("kernel_sizes")]
+    sh, sw = [int(v) for v in ctx.attr("strides", [1, 1])]
+    ph, pw = [int(v) for v in ctx.attr("paddings", [0, 0])][:2]
+    dh, dw = [int(v) for v in ctx.attr("dilations", [1, 1])]
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + sh * oh:sh,
+                       j * dw:j * dw + sw * ow:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
+    return {"Y": out.reshape(N, C * kh * kw, oh * ow)}
+
+
+@register_op("grid_sampler")
+def grid_sampler(ctx: ExecContext):
+    """reference grid_sampler_op.*: bilinear sampling of X [N,C,H,W] at
+    Grid [N,Ho,Wo,2] normalized coords (align_corners=True)."""
+    x = ctx.input("X").astype(jnp.float32)
+    grid = ctx.input("Grid").astype(jnp.float32)
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1) * (W - 1) / 2
+    gy = (grid[..., 1] + 1) * (H - 1) / 2
+
+    def sample(img, gx, gy):
+        # out-of-bound corners contribute ZERO (reference grid_sampler_op.h
+        # GetGridPointValue isInBound), not a clamped border value
+        x0f, y0f = jnp.floor(gx), jnp.floor(gy)
+        corners = []
+        for dy in (0, 1):
+            for dx in (0, 1):
+                cx_, cy_ = x0f + dx, y0f + dy
+                inb = (cx_ >= 0) & (cx_ <= W - 1) & (cy_ >= 0) & (cy_ <= H - 1)
+                xi = jnp.clip(cx_, 0, W - 1).astype(jnp.int32)
+                yi = jnp.clip(cy_, 0, H - 1).astype(jnp.int32)
+                wgt = ((1 - jnp.abs(gx - cx_)) * (1 - jnp.abs(gy - cy_)))
+                corners.append(jnp.where(inb, wgt, 0.0) * img[:, yi, xi])
+        return corners[0] + corners[1] + corners[2] + corners[3]
+
+    out = jax.vmap(sample)(x, gx, gy)
+    return {"Output": out}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx: ExecContext):
+    """reference bilinear_tensor_product_op.*: out[b,k] = x[b] W[k] y[b]."""
+    x, y, w = ctx.input("X"), ctx.input("Y"), ctx.input("Weight")
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias")
+    return {"Out": out}
+
+
+@register_op("shard_index", grad="none")
+def shard_index(ctx: ExecContext):
+    x = ctx.input("X")
+    index_num = int(ctx.attr("index_num"))
+    nshards = int(ctx.attr("nshards"))
+    shard_id = int(ctx.attr("shard_id"))
+    ignore = int(ctx.attr("ignore_value", -1))
+    per = (index_num + nshards - 1) // nshards
+    local = x - shard_id * per
+    ok = (x // per) == shard_id
+    return {"Out": jnp.where(ok, local, jnp.full_like(x, ignore))}
+
+
+@register_op("sampling_id", grad="none", needs_rng=True)
+def sampling_id(ctx: ExecContext):
+    """reference sampling_id_op.*: sample one category per row of a
+    probability matrix."""
+    p = ctx.input("X")
+    return {"Out": jax.random.categorical(
+        ctx.rng, jnp.log(jnp.maximum(p, 1e-20)), axis=-1).astype(jnp.int64)}

@@ -1,0 +1,56 @@
+"""Graphviz program dump (reference fluid/debugger.py) and dygraph VarBase
+operator sugar (reference dygraph/math_op_patch.py)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph as dg
+from paddle_tpu import layers as L
+
+
+def test_program_to_dot(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        h = L.fc(x, size=2, name="head")
+        L.mean(h)
+    dot = pt.debugger.draw_block_graphviz(
+        main, highlights=["x"], path=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph")
+    assert "mul" in dot and "mean" in dot       # op nodes
+    assert "head.w_0" in dot                     # parameter node
+    assert "#ffe6cc" in dot                      # highlight applied
+    assert (tmp_path / "g.dot").read_text() == dot
+    # every edge references a declared node
+    import re
+    declared = set(re.findall(r"^\s+(\w+) \[", dot, re.M))
+    for a, b in re.findall(r"^\s+(\w+) -> (\w+);", dot, re.M):
+        assert a in declared and b in declared
+
+
+def test_varbase_operator_sugar():
+    with dg.guard(seed=1):
+        a = dg.to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        b = dg.to_variable(np.array([[2.0, 2.0], [2.0, 2.0]], np.float32))
+        np.testing.assert_allclose((a / b).numpy(), a.numpy() / 2)
+        np.testing.assert_allclose((a ** b).numpy(), a.numpy() ** 2)
+        np.testing.assert_allclose((-a).numpy(), -a.numpy())
+        np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy())
+        np.testing.assert_allclose((3.0 - a).numpy(), 3.0 - a.numpy())
+        np.testing.assert_allclose((8.0 / b).numpy(), 4.0)
+        assert (a > 2.5).numpy().astype(bool).tolist() == [[False, False],
+                                                           [True, True]]
+        assert (a <= 1.0).numpy().astype(bool).tolist() == [[True, False],
+                                                            [False, False]]
+
+
+def test_varbase_sugar_backward():
+    """Gradients flow through the patched operators."""
+    with dg.guard(seed=2):
+        w = dg.VarBase(np.array([[2.0, 3.0]], np.float32), persistable=True)
+        loss_parts = (w * w) / 2.0 - w
+        from paddle_tpu.dygraph import _dy_op
+        loss = _dy_op("mean", {"X": [loss_parts]})["Out"]
+        loss.backward()
+        # d/dw mean(w^2/2 - w) = (w - 1) / n
+        np.testing.assert_allclose(w.gradient(), (np.array([[2.0, 3.0]]) - 1) / 2,
+                                   rtol=1e-6)

@@ -1,0 +1,201 @@
+"""Layer-DSL long tail (reference nn.py parity batch): every new wrapper
+builds + executes; differentiable ones train through append_backward."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def _run(build, feeds, n_fetch=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=list(outs)[:n_fetch])
+    return [np.asarray(v) for v in vals]
+
+
+def test_activation_wrappers():
+    x = np.linspace(-3, 3, 12).astype(np.float32).reshape(2, 6)
+    def build():
+        v = L.data(name="x", shape=[6], dtype="float32")
+        return [L.elu(v), L.relu6(v), L.hard_sigmoid(v), L.swish(v),
+                L.selu(v), L.sign(v), L.brelu(v), L.soft_relu(v),
+                L.stanh(v), L.hard_swish(v)]
+    outs = _run(build, {"x": x}, n_fetch=10)
+    np.testing.assert_allclose(
+        outs[0], np.where(x >= 0, x, np.exp(x) - 1), rtol=1e-5)  # elu
+    np.testing.assert_allclose(outs[1], np.clip(x, 0, 6), rtol=1e-6)
+    np.testing.assert_allclose(outs[5], np.sign(x))
+    s = 1.0507009873554805; a = 1.6732632423543772
+    np.testing.assert_allclose(
+        outs[4], s * np.where(x >= 0, x, a * (np.exp(x) - 1)), rtol=1e-5)
+
+
+def test_elementwise_and_reduce_wrappers():
+    x = np.array([[7.0, -3.0], [5.0, 2.0]], np.float32)
+    y = np.array([[2.0, 2.0], [3.0, 2.0]], np.float32)
+    def build():
+        a = L.data(name="x", shape=[2], dtype="float32")
+        b = L.data(name="y", shape=[2], dtype="float32")
+        m = L.elementwise_mod(a, b)
+        f = L.elementwise_floordiv(a, b)
+        anyv = L.reduce_any(L.greater_than(a, b))
+        allv = L.reduce_all(L.greater_than(a, b))
+        return [m, f, anyv, allv]
+    m, f, anyv, allv = _run(build, {"x": x, "y": y}, n_fetch=4)
+    np.testing.assert_allclose(m, np.mod(x, y))
+    np.testing.assert_allclose(f, np.floor_divide(x, y))
+    assert bool(anyv) is True and bool(allv) is False
+
+
+def test_loss_wrappers():
+    rng = np.random.default_rng(0)
+    logp = np.log(rng.dirichlet(np.ones(4), 6)).astype(np.float32)
+    tgt = rng.dirichlet(np.ones(4), 6).astype(np.float32)
+    pred = rng.random((6, 1)).astype(np.float32) * 0.8 + 0.1
+    lbl = rng.integers(0, 2, (6, 1)).astype(np.float32)
+    def build():
+        lp = L.data(name="lp", shape=[4], dtype="float32")
+        t = L.data(name="t", shape=[4], dtype="float32")
+        p = L.data(name="p", shape=[1], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="float32")
+        return [L.kldiv_loss(lp, t, reduction="mean"),
+                L.log_loss(p, y),
+                L.huber_loss(p, y, delta=0.5),
+                L.rank_loss(y, p, p)]
+    kld, ll, hub, rl = _run(build, {"lp": logp, "t": tgt, "p": pred,
+                                    "y": lbl}, n_fetch=4)
+    ref_kld = (tgt * (np.log(tgt) - logp)).mean()
+    np.testing.assert_allclose(kld, ref_kld, rtol=1e-4)
+    ref_ll = -lbl * np.log(pred + 1e-4) - (1 - lbl) * np.log(1 - pred + 1e-4)
+    np.testing.assert_allclose(ll, ref_ll, rtol=1e-3)
+    np.testing.assert_allclose(rl, np.log1p(np.exp(0.0)) - lbl * 0.0,
+                               rtol=1e-5)
+    assert np.isfinite(hub).all()
+
+
+def test_vision_layout_wrappers():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 8, 4, 4)).astype(np.float32)
+    def build():
+        v = L.data(name="x", shape=[8, 4, 4], dtype="float32")
+        return [L.pixel_shuffle(v, 2), L.shuffle_channel(v, 4),
+                L.space_to_depth(v, 2), L.maxout(v, 2),
+                L.adaptive_pool2d(v, [2, 2], "avg"),
+                L.resize_bilinear(v, out_shape=(8, 8)),
+                L.resize_nearest(v, out_shape=(2, 2)),
+                L.lrn(v), L.temporal_shift(v, seg_num=2)]
+    outs = _run(build, {"x": x}, n_fetch=9)
+    assert outs[0].shape == (2, 2, 8, 8)    # pixel_shuffle
+    assert outs[1].shape == x.shape         # shuffle_channel
+    assert outs[2].shape == (2, 32, 2, 2)   # space_to_depth
+    assert outs[3].shape == (2, 4, 4, 4)    # maxout
+    np.testing.assert_allclose(
+        outs[4], x.reshape(2, 8, 2, 2, 2, 2).mean(axis=(3, 5)), rtol=1e-5)
+    assert outs[5].shape == (2, 8, 8, 8)
+    np.testing.assert_allclose(outs[6], x[:, :, 1::2, 1::2])  # nearest half-pixel
+    assert outs[7].shape == x.shape
+    assert outs[8].shape == x.shape
+
+
+def test_tensor_wrappers():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((3, 4)).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    upd = np.array([10.0, 20.0], np.float32)
+    def build():
+        v = L.data(name="x", shape=[4], dtype="float32")
+        i = L.data(name="i", shape=[2], dtype="int64")
+        u = L.data(name="u", shape=[], dtype="float32")
+        return [L.gather_nd(v, i), L.scatter_nd_add(v, i, u),
+                L.rank(v), L.size(v), L.sum([v, v]),
+                L.crop(v, shape=[2, 2], offsets=[1, 1]),
+                L.shard_index(i, index_num=8, nshards=2, shard_id=0)]
+    g, sc, rk, sz, sm, cr, sh = _run(
+        build, {"x": x, "i": idx, "u": upd}, n_fetch=7)
+    np.testing.assert_allclose(g, x[idx[:, 0], idx[:, 1]])
+    ref = x.copy(); ref[0, 1] += 10; ref[2, 3] += 20
+    np.testing.assert_allclose(sc, ref)
+    assert int(rk) == 2 and int(sz) == 12
+    np.testing.assert_allclose(sm, 2 * x)
+    np.testing.assert_allclose(cr, x[1:3, 1:3])
+    np.testing.assert_array_equal(sh, np.where(idx < 4, idx, -1))
+
+
+def test_conv3d_and_pool3d_train():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 3, 4, 6, 6)).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            v = L.data(name="x", shape=[3, 4, 6, 6], dtype="float32")
+            c = L.conv3d(v, num_filters=4, filter_size=3, padding=1,
+                         act="relu")
+            p = L.pool3d(c, pool_size=2, pool_type="avg", pool_stride=2)
+            loss = L.mean(p)
+            pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        w0 = np.asarray(pt.global_scope().find_var(
+            main.all_parameters()[0].name)).copy()
+        (lv,) = exe.run(main, feed={"x": x}, fetch_list=[loss])
+        w1 = np.asarray(pt.global_scope().find_var(
+            main.all_parameters()[0].name))
+    assert np.isfinite(float(np.asarray(lv)))
+    assert not np.allclose(w0, w1)
+
+
+def test_grid_sampler_identity():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+    def build():
+        v = L.data(name="x", shape=[2, 4, 4], dtype="float32")
+        g = L.data(name="g", shape=[4, 4, 2], dtype="float32")
+        return L.grid_sampler(v, g)
+    (out,) = _run(build, {"x": x, "g": grid})
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)  # identity grid
+
+
+def test_misc_wrappers():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((2, 3)).astype(np.float32)
+    y = rng.standard_normal((2, 4)).astype(np.float32)
+    sel = np.array([[1], [0]], np.int64)
+    def build():
+        a = L.data(name="x", shape=[3], dtype="float32")
+        b = L.data(name="y", shape=[4], dtype="float32")
+        i = L.data(name="i", shape=[1], dtype="int64")
+        btp = L.bilinear_tensor_product(a, b, size=5)
+        mux = L.multiplex([a, L.scale(a, scale=2.0)], i)
+        seq = L.data(name="s", shape=[4, 8], dtype="float32")
+        pe = L.add_position_encoding(seq)
+        return [btp, mux, pe]
+    s = rng.standard_normal((2, 4, 8)).astype(np.float32)
+    btp, mux, pe = _run(build, {"x": x, "y": y, "i": sel, "s": s}, n_fetch=3)
+    assert btp.shape == (2, 5)
+    np.testing.assert_allclose(mux, np.stack([x[0] * 2, x[1]]), rtol=1e-6)
+    assert pe.shape == s.shape and not np.allclose(pe, s)
+
+
+def test_unfold_matches_manual_im2col():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    def build():
+        v = L.data(name="x", shape=[2, 4, 4], dtype="float32")
+        return L.unfold(v, kernel_sizes=[2, 2], strides=2)
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (1, 8, 4)
+    # first output column = top-left 2x2 patch, channel-major kh-kw order
+    ref0 = np.stack([x[0, :, 0, 0], x[0, :, 0, 1],
+                     x[0, :, 1, 0], x[0, :, 1, 1]], axis=1).reshape(-1)
+    np.testing.assert_allclose(out[0, :, 0], ref0)

@@ -152,8 +152,9 @@ def test_anchor_generator_shapes_and_values():
                    fetch_list=["A", "V"])
     a = np.asarray(a)
     assert a.shape == (2, 3, 2, 4) and np.asarray(v).shape == a.shape
-    # first cell center (8, 8), size-32 square anchor
-    np.testing.assert_allclose(a[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+    # reference math (anchor_generator_op.h:55-81): center = 0*16+0.5*15 =
+    # 7.5, size-32 square spans ±(32-1)/2 -> [-8, -8, 23, 23]
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 23, 23])
 
 
 def test_bipartite_match_greedy():

@@ -1,0 +1,140 @@
+"""Slim pruning + distillation (reference contrib/slim prune/ and
+distillation/): mask sparsity, mask persistence through training,
+sensitivity probe, and teacher->student distillation convergence."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.contrib.slim.distillation import (
+    fsp_matrix,
+    l2_distill_loss,
+    soft_label_loss,
+)
+from paddle_tpu.contrib.slim.prune import MagnitudePruner, sensitivity
+
+
+def test_magnitude_prune_sparsity_and_training_persistence():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 5
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[16], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            h = L.fc(x, size=32, act="relu", name="h")
+            pred = L.fc(h, size=1, name="p")
+            loss = L.mean(L.square_error_cost(pred, y))
+            pt.optimizer.SGD(0.05).minimize(loss)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((16, 1)).astype(np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        MagnitudePruner().apply(["h.w_0"], 0.5, scope=scope, program=main)
+        w = np.asarray(scope.find_var("h.w_0"))
+        sparsity = float((w == 0).mean())
+        assert 0.45 <= sparsity <= 0.55, sparsity
+        mask = np.asarray(scope.find_var("h.w_0@prune_mask"))
+        for _ in range(20):
+            xb = rng.standard_normal((32, 16)).astype(np.float32)
+            (lv,) = exe.run(main, feed={"x": xb, "y": xb @ w_true},
+                            fetch_list=[loss])
+        w_after = np.asarray(scope.find_var("h.w_0"))
+        # pruned entries stay EXACTLY zero through 20 SGD steps
+        assert np.all(w_after[mask == 0] == 0.0)
+        # surviving entries trained
+        assert not np.allclose(w_after[mask == 1], w[mask == 1])
+        assert np.isfinite(float(np.asarray(lv)))
+
+
+def test_structured_prune_removes_whole_columns():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((8, 10)).astype(np.float32)
+    scope = pt.Scope()
+    scope.set_var("w", w)
+    MagnitudePruner(structured=True).prune_weights(scope, ["w"], 0.3)
+    out = np.asarray(scope.find_var("w"))
+    col_zero = (out == 0).all(axis=0)
+    assert col_zero.sum() == 3  # floor(0.3 * 10) whole columns
+    # the removed columns are the smallest-norm ones
+    norms = np.sqrt((w ** 2).sum(axis=0))
+    assert set(np.nonzero(col_zero)[0]) == set(np.argsort(norms)[:3])
+
+
+def test_sensitivity_probe_restores_and_ranks():
+    scope = pt.Scope()
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((6, 6)).astype(np.float32)
+    scope.set_var("w", w.copy())
+
+    def eval_fn():
+        # toy metric = remaining weight magnitude: pruning strictly lowers it
+        return float(np.abs(np.asarray(scope.find_var("w"))).sum())
+
+    out = sensitivity(None, scope, None, ["w"], eval_fn, ratios=(0.2, 0.6))
+    # restored after probing
+    np.testing.assert_array_equal(np.asarray(scope.find_var("w")), w)
+    # heavier pruning loses more metric
+    assert out["w"][0.6] < out["w"][0.2]
+
+
+def test_distillation_soft_label_student_learns_teacher():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 9
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[8], dtype="float32")
+            # frozen teacher tower
+            t_logits = L.fc(x, size=4, name="teacher")
+            t_logits.stop_gradient = True
+            # student tower
+            s_logits = L.fc(x, size=4, name="student")
+            loss = soft_label_loss(t_logits, s_logits,
+                                   teacher_temperature=2.0,
+                                   student_temperature=2.0)
+            opt = pt.optimizer.Adam(5e-2)
+            params = [p for p in main.all_parameters()
+                      if p.name.startswith("student")]
+            opt.minimize(loss, parameter_list=params)
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.default_rng(3)
+    xb = rng.standard_normal((64, 8)).astype(np.float32)  # fixed batch
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        t0 = np.asarray(scope.find_var("teacher.w_0")).copy()
+        tb = np.asarray(scope.find_var("teacher.b_0"))
+        losses = []
+        for _ in range(80):
+            (lv,) = exe.run(main, feed={"x": xb}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        # teacher untouched
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var("teacher.w_0")), t0)
+        # cross-entropy against soft targets bottoms out at the TEACHER's
+        # entropy, not 0 — assert the KL component (loss - H) collapsed
+        z = (xb @ t0 + tb) / 2.0
+        p_t = np.exp(z - z.max(1, keepdims=True))
+        p_t /= p_t.sum(1, keepdims=True)
+        floor = float(-(p_t * np.log(p_t)).sum(1).mean())
+        kl0, kl1 = losses[0] - floor, losses[-1] - floor
+        assert kl1 < 0.1 * kl0, (losses[0], losses[-1], floor)
+
+
+def test_fsp_matrix_matches_numpy():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = L.data(name="a", shape=[3, 4, 5], dtype="float32")
+        b = L.data(name="b", shape=[2, 4, 5], dtype="float32")
+        m = fsp_matrix(a, b)
+        l2 = l2_distill_loss(m, m)
+    exe = pt.Executor()
+    rng = np.random.default_rng(4)
+    av = rng.standard_normal((2, 3, 4, 5)).astype(np.float32)
+    bv = rng.standard_normal((2, 2, 4, 5)).astype(np.float32)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        mv, lv = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[m, l2])
+    ref = np.einsum("bchw,bdhw->bcd", av, bv) / 20.0
+    np.testing.assert_allclose(np.asarray(mv), ref, rtol=1e-5, atol=1e-6)
+    assert float(np.asarray(lv)) == 0.0
