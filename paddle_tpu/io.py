@@ -31,6 +31,8 @@ __all__ = [
     "save_vars", "save_params", "save_persistables",
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model",
+    "save_train_model",
+    "load_train_model",
 ]
 
 _MODEL_FILENAME = "__model__.json"
@@ -318,3 +320,50 @@ def load_sharded(executor=None, dirname="", main_program=None, scope=None,
         enc = _encode_name(n)
         if enc in restored:
             scope.set_var(n, restored[enc])
+
+
+# ---------------------------------------------------------------------------
+# full train-model save/load (the native standalone trainer's input format)
+# ---------------------------------------------------------------------------
+
+
+def save_train_model(dirname: str, feed_order, loss, executor=None,
+                     main_program=None, startup_program=None, scope=None):
+    """Persist the FULL training state: main + startup programs (with
+    backward and optimizer ops), every persistable value, and a meta file
+    naming the feeds/loss. This is what the native standalone trainer
+    (native/standalone_trainer.c) consumes — the reference's role of the
+    saved ProgramDesc that train/demo_trainer.cc loads."""
+    from .framework import default_startup_program
+
+    program = main_program or default_main_program()
+    startup = startup_program or default_startup_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "train_main.json"), "w") as f:
+        json.dump(program.to_dict(), f)
+    with open(os.path.join(dirname, "train_startup.json"), "w") as f:
+        json.dump(startup.to_dict(), f)
+    meta = {
+        "feed_names": [v.name if isinstance(v, Variable) else str(v)
+                       for v in feed_order],
+        "loss_name": loss.name if isinstance(loss, Variable) else str(loss),
+    }
+    with open(os.path.join(dirname, "train_meta.json"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, program, scope=scope)
+    return meta
+
+
+def load_train_model(dirname: str, executor=None, scope=None):
+    """Inverse of save_train_model: returns (main, startup, meta) with
+    persistables loaded into the scope."""
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, "train_main.json")) as f:
+        program = Program.from_dict(json.load(f))
+    with open(os.path.join(dirname, "train_startup.json")) as f:
+        startup = Program.from_dict(json.load(f))
+    with open(os.path.join(dirname, "train_meta.json")) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, program, scope=scope)
+    return program, startup, meta

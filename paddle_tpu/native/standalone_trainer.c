@@ -1,0 +1,143 @@
+/* Standalone native trainer.
+ *
+ * Role parity with the reference's Python-free train path
+ * (/root/reference/paddle/fluid/train/demo/demo_trainer.cc: load a saved
+ * ProgramDesc, run startup + main with the C++ Executor). On this
+ * TPU-native stack the execution engine is XLA (native code reached through
+ * the embedded runtime), so the standalone trainer is a C binary that hosts
+ * the runtime in-process: no user Python, no scripts — argv in, trained
+ * parameters out.
+ *
+ *   standalone_trainer MODEL_DIR DATA_FILE BATCH [EPOCHS] [SAVE_DIR]
+ *
+ * MODEL_DIR is io.save_train_model output (train_main/train_startup/
+ * train_meta.json + persistables); DATA_FILE is MultiSlot text (the native
+ * parser's format); trained persistables are written to SAVE_DIR (default:
+ * MODEL_DIR).
+ *
+ * Build (tools/build_standalone_trainer.sh or the test):
+ *   cc standalone_trainer.c $(python3-config --includes) \
+ *      $(python3-config --ldflags --embed) -o standalone_trainer
+ */
+#include <Python.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static const char *DRIVER =
+    "import json, os, sys\n"
+    "model_dir, data_file, batch, epochs, save_dir = (\n"
+    "    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),\n"
+    "    sys.argv[5])\n"
+    "sys.path.insert(0, os.environ.get('PADDLE_TPU_HOME', os.getcwd()))\n"
+    "import paddle_tpu as pt\n"
+    "exe = pt.Executor()\n"
+    "main, startup, meta = pt.io.load_train_model(model_dir, exe)\n"
+    "ds = pt.DatasetFactory().create_dataset('QueueDataset')\n"
+    "ds.set_batch_size(batch)\n"
+    "use_vars = [main.global_block.var(n) for n in meta['feed_names']]\n"
+    "ds.set_use_var(use_vars)\n"
+    "ds.set_filelist([data_file])\n"
+    "for _ in range(epochs):\n"
+    "    exe.train_from_dataset(main, ds, fetch_list=[meta['loss_name']],\n"
+    "                           fetch_info=['loss'], print_period=10)\n"
+    "pt.io.save_persistables(exe, save_dir, main)\n"
+    "print('standalone_trainer: saved to', save_dir, flush=True)\n";
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s MODEL_DIR DATA_FILE BATCH [EPOCHS] [SAVE_DIR]\n",
+            argv[0]);
+    return 2;
+  }
+  const char *model_dir = argv[1];
+  const char *data_file = argv[2];
+  const char *batch = argv[3];
+  const char *epochs = argc > 4 ? argv[4] : "1";
+  const char *save_dir = argc > 5 ? argv[5] : argv[1];
+
+  PyStatus status;
+  PyConfig config;
+  PyConfig_InitPythonConfig(&config);
+  /* forward the trainer's argv into the embedded runtime */
+  wchar_t *wargv[6];
+  const char *cargv[6] = {"standalone_trainer", model_dir, data_file,
+                          batch,               epochs,    save_dir};
+  for (int i = 0; i < 6; i++) {
+    wargv[i] = Py_DecodeLocale(cargv[i], NULL);
+    if (!wargv[i]) {
+      fprintf(stderr, "standalone_trainer: argv decode failed\n");
+      return 1;
+    }
+  }
+  status = PyConfig_SetArgv(&config, 6, wargv);
+  if (PyStatus_Exception(status)) goto fail;
+  config.parse_argv = 0; /* argv is data, not interpreter options */
+
+  /* Resolve the runtime environment the way a shell would: the PATH's
+   * python3 (or $PADDLE_TPU_PYTHON) — so a virtualenv's site-packages
+   * (jaxlib, numpy: the native compute stack) is found. Without this the
+   * embedded interpreter initializes against the bare system prefix. */
+  {
+    char pybuf[4096] = {0};
+    const char *pyexe = getenv("PADDLE_TPU_PYTHON");
+    if (!pyexe) {
+      FILE *p = popen("command -v python3", "r");
+      if (p) {
+        if (fgets(pybuf, sizeof(pybuf) - 1, p)) {
+          pybuf[strcspn(pybuf, "\n")] = 0;
+          if (pybuf[0]) pyexe = pybuf;
+        }
+        pclose(p);
+      }
+    }
+    if (pyexe) {
+      /* the resolved interpreter must match the libpython this binary was
+       * linked against — a PATH pointing at a different minor version would
+       * otherwise die deep in Py_InitializeFromConfig with an opaque
+       * encodings error */
+      char cmd[4352];
+      snprintf(cmd, sizeof(cmd),
+               "'%s' -c 'import sys;print(\"%%d.%%d\"%%sys.version_info[:2])'",
+               pyexe);
+      FILE *v = popen(cmd, "r");
+      char ver[32] = {0};
+      if (v) {
+        if (fgets(ver, sizeof(ver) - 1, v)) ver[strcspn(ver, "\n")] = 0;
+        pclose(v);
+      }
+      char want[32];
+      snprintf(want, sizeof(want), "%d.%d", PY_MAJOR_VERSION,
+               PY_MINOR_VERSION);
+      if (ver[0] && strcmp(ver, want) != 0) {
+        fprintf(stderr,
+                "standalone_trainer: python3 on PATH is %s but this binary "
+                "embeds %s — set PADDLE_TPU_PYTHON to a %s interpreter\n",
+                ver, want, want);
+        return 1;
+      }
+      status = PyConfig_SetBytesString(&config, &config.executable, pyexe);
+      if (PyStatus_Exception(status)) goto fail;
+    }
+  }
+  status = Py_InitializeFromConfig(&config);
+  if (PyStatus_Exception(status)) goto fail;
+  PyConfig_Clear(&config);
+
+  int rc = PyRun_SimpleString(DRIVER);
+  if (rc != 0) {
+    fprintf(stderr, "standalone_trainer: training failed\n");
+    Py_Finalize();
+    return 1;
+  }
+  if (Py_FinalizeEx() < 0) return 120;
+  for (int i = 0; i < 6; i++) PyMem_RawFree(wargv[i]);
+  return 0;
+
+fail:
+  PyConfig_Clear(&config);
+  fprintf(stderr, "standalone_trainer: runtime init failed: %s\n",
+          status.err_msg ? status.err_msg : "?");
+  return 1;
+}

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build the native standalone trainer (paddle_tpu/native/standalone_trainer.c).
+set -e
+DIR="$(cd "$(dirname "$0")/.." && pwd)"
+SRC="$DIR/paddle_tpu/native/standalone_trainer.c"
+OUT="${1:-$DIR/paddle_tpu/native/standalone_trainer}"
+CFLAGS="$(python3-config --includes)"
+LDFLAGS="$(python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags)"
+${CC:-cc} -O2 "$SRC" $CFLAGS $LDFLAGS -o "$OUT"
+echo "built $OUT"
