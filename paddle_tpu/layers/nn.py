@@ -17,6 +17,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "Print",
+    "linear_chain_crf",
+    "crf_decoding",
     "elu",
     "relu6",
     "hard_sigmoid",
@@ -1585,3 +1587,37 @@ def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
     enc = assign(table)
     return elementwise_add(scale(input, scale=alpha),
                            scale(enc, scale=beta))
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF negative log-likelihood (reference nn.py
+    linear_chain_crf -> linear_chain_crf_op). `input` [B, T, N] emissions;
+    transition parameter shape [N+2, N] (start/stop rows + NxN)."""
+    helper = LayerHelper("linear_chain_crf")
+    n = input.shape[-1]
+    w = helper.create_parameter(attr=param_attr, shape=[n + 2, n],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": [input], "Transition": [w], "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("linear_chain_crf", inputs,
+                     {"LogLikelihood": [out]}, {})
+    return out
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the trained CRF transition (reference nn.py
+    crf_decoding). With `label`, returns the per-position mismatch
+    indicator instead of the path."""
+    helper = LayerHelper("crf_decoding")
+    w = helper.main_program.current_block().var(
+        param_attr.name if hasattr(param_attr, "name") else str(param_attr))
+    out = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [w]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op("crf_decoding", inputs, {"ViterbiPath": [out]}, {})
+    return out

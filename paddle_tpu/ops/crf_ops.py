@@ -1,0 +1,123 @@
+"""Linear-chain CRF: log-likelihood + Viterbi decoding.
+
+TPU-native re-design of the reference CRF pair
+(/root/reference/paddle/fluid/operators/linear_chain_crf_op.{h,cc} and
+crf_decoding_op.{h,cc}): the reference walks LoD sequences with explicit
+alpha tables; here the forward recursion is a lax.scan over the padded time
+axis in LOG space (no exp-table bookkeeping — the derived vjp through
+logsumexp IS the backward the reference hand-writes), masked by Length.
+
+Transition layout (reference contract): [N+2, N] — row 0 start weights,
+row 1 stop weights, rows 2..N+1 the NxN transition matrix.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ExecContext, register_op
+
+_NEG = -1e30
+
+
+def _split_transition(w):
+    return w[0], w[1], w[2:]  # start [N], stop [N], trans [N, N]
+
+
+def _crf_nll(emission, label, length, w):
+    """Negative log-likelihood per sequence (the reference op's
+    LogLikelihood output is the COST users feed to mean()).
+    emission [T, N] fp32, label [T] int, length scalar int, w [N+2, N]."""
+    T, N = emission.shape
+    start, stop, trans = _split_transition(w)
+    t_idx = jnp.arange(T)
+    valid = t_idx < length
+
+    # partition function: alpha recursion in log space
+    alpha0 = start + emission[0]
+
+    def step(alpha, t):
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, None] + trans, axis=0) + emission[t]
+        return jnp.where(valid[t], nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    last = jnp.maximum(length - 1, 0)
+    log_z = jax.scipy.special.logsumexp(alpha + stop)
+
+    # gold path score
+    lbl = label.astype(jnp.int32)
+    em_score = jnp.sum(jnp.where(valid, emission[t_idx, lbl], 0.0))
+    prev, cur = lbl[:-1], lbl[1:]
+    tr_score = jnp.sum(jnp.where(valid[1:], trans[prev, cur], 0.0))
+    score = start[lbl[0]] + em_score + tr_score + stop[lbl[last]]
+    return log_z - score
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(ctx: ExecContext):
+    """inputs: Emission [B, T, N], Transition [N+2, N], Label [B, T] (or
+    [B, T, 1]), optional Length [B]. outputs: LogLikelihood [B, 1]."""
+    em = ctx.input("Emission").astype(jnp.float32)
+    w = ctx.input("Transition").astype(jnp.float32)
+    label = ctx.input("Label")
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label.reshape(label.shape[:-1])
+    B, T = em.shape[0], em.shape[1]
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((B,), T, jnp.int32)
+    nll = jax.vmap(_crf_nll, in_axes=(0, 0, 0, None))(em, label, length, w)
+    return {"LogLikelihood": nll[:, None]}
+
+
+@register_op("crf_decoding", grad="none")
+def crf_decoding(ctx: ExecContext):
+    """Viterbi decode (reference crf_decoding_op.h): best path per sequence.
+    With a Label input the output is the per-position MISMATCH indicator
+    (the reference's "compare with ground truth" mode); padding positions
+    emit 0."""
+    em = ctx.input("Emission").astype(jnp.float32)
+    w = ctx.input("Transition").astype(jnp.float32)
+    B, T, N = em.shape
+    if ctx.has_input("Length"):
+        length = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((B,), T, jnp.int32)
+    start, stop, trans = _split_transition(w)
+
+    def decode(e, ln):
+        valid = jnp.arange(T) < ln
+        v0 = start + e[0]
+
+        def step(v, t):
+            cand = v[:, None] + trans             # [from, to]
+            best = jnp.max(cand, axis=0) + e[t]
+            bp = jnp.argmax(cand, axis=0).astype(jnp.int32)
+            v_new = jnp.where(valid[t], best, v)
+            bp = jnp.where(valid[t], bp,
+                           jnp.arange(N, dtype=jnp.int32))  # identity ptr
+            return v_new, bp
+
+        v_last, bps = jax.lax.scan(step, v0, jnp.arange(1, T))
+        last_tag = jnp.argmax(v_last + stop).astype(jnp.int32)
+
+        def back(tag, bp):
+            prev = bp[tag]
+            return prev, tag
+
+        _, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+        path = jnp.concatenate([path_rev, last_tag[None]])
+        return jnp.where(valid, path, 0)
+
+    paths = jax.vmap(decode)(em, length)
+    if ctx.has_input("Label"):
+        label = ctx.input("Label")
+        if label.ndim == 3 and label.shape[-1] == 1:
+            label = label.reshape(label.shape[:-1])
+        valid = jnp.arange(T)[None, :] < length[:, None]
+        mism = (paths != label.astype(jnp.int32)) & valid
+        return {"ViterbiPath": mism.astype(jnp.int64)}
+    return {"ViterbiPath": paths.astype(jnp.int64)}

@@ -66,8 +66,10 @@ def momentum(ctx: ExecContext):
     stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"),
 )
 def adam(ctx: ExecContext):
+    from ..core.selected_rows import is_selected_rows
+
     p = ctx.input("Param")
-    g = _reject_sparse(ctx, ctx.input("Grad")).astype(jnp.float32)
+    g = ctx.input("Grad")
     m1 = ctx.input("Moment1")
     m2 = ctx.input("Moment2")
     b1p = ctx.input("Beta1Pow").reshape(())
@@ -75,9 +77,27 @@ def adam(ctx: ExecContext):
     b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx) * jnp.sqrt(1 - b2p) / (1 - b1p)
-    m1n = b1 * m1 + (1 - b1) * g
-    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
-    p_new = p.astype(jnp.float32) - lr * (m1n / (jnp.sqrt(m2n) + eps))
+    if is_selected_rows(g):
+        # lazy sparse Adam (reference adam_op.h SparseAdamFunctor with
+        # lazy_mode=True): only the TOUCHED rows' moments decay and update —
+        # the embedding-table behavior the dense form can't afford. Duplicate
+        # rows first merge by sum (reference merge_add of the SelectedRows).
+        rows = g.rows.astype(jnp.int32)
+        merged = jnp.zeros((p.shape[0],) + g.values.shape[1:],
+                           jnp.float32).at[rows].add(
+                               g.values.astype(jnp.float32))
+        touched = jnp.zeros((p.shape[0],), bool).at[rows].set(True)
+        tmask = touched.reshape((-1,) + (1,) * (p.ndim - 1))
+        m1n = jnp.where(tmask, b1 * m1 + (1 - b1) * merged, m1)
+        m2n = jnp.where(tmask, b2 * m2 + (1 - b2) * jnp.square(merged), m2)
+        upd = lr * (m1n / (jnp.sqrt(m2n) + eps))
+        p_new = jnp.where(tmask, p.astype(jnp.float32) - upd,
+                          p.astype(jnp.float32))
+    else:
+        gf = g.astype(jnp.float32)
+        m1n = b1 * m1 + (1 - b1) * gf
+        m2n = b2 * m2 + (1 - b2) * jnp.square(gf)
+        p_new = p.astype(jnp.float32) - lr * (m1n / (jnp.sqrt(m2n) + eps))
     return {
         "ParamOut": p_new.astype(p.dtype),
         "Moment1Out": m1n,

@@ -1,0 +1,129 @@
+"""Linear-chain CRF (reference linear_chain_crf_op + crf_decoding_op):
+brute-force enumeration oracle for the partition function and Viterbi path,
+numeric gradient check, and an end-to-end tagging train that beats the
+emission-only argmax on transition-dependent data."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+from op_test import OpTest
+
+
+def _brute_nll(em, label, w):
+    """Enumerate all tag paths: nll = logZ - score(gold)."""
+    T, N = em.shape
+    start, stop, trans = w[0], w[1], w[2:]
+
+    def score(path):
+        s = start[path[0]] + em[0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + em[t, path[t]]
+        return s + stop[path[-1]]
+
+    scores = [score(p) for p in itertools.product(range(N), repeat=T)]
+    log_z = np.log(np.sum(np.exp(np.array(scores) - max(scores)))) + max(scores)
+    return log_z - score(list(label)), scores
+
+
+class TestLinearChainCrf(OpTest):
+    def _setup(self):
+        rng = np.random.default_rng(0)
+        B, T, N = 2, 4, 3
+        em = rng.standard_normal((B, T, N)).astype(np.float32)
+        w = (rng.standard_normal((N + 2, N)) * 0.5).astype(np.float32)
+        label = rng.integers(0, N, (B, T)).astype(np.int64)
+        expect = np.array([[_brute_nll(em[b], label[b], w)[0]]
+                           for b in range(B)], np.float32)
+        self.setup("linear_chain_crf",
+                   {"Emission": em, "Transition": w, "Label": label},
+                   {"LogLikelihood": expect}, {})
+
+    def test_output(self):
+        self._setup()
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self._setup()
+        self.check_grad(["Emission_in", "Transition_in"], "LogLikelihood",
+                        max_relative_error=2e-2, no_grad_set={"Label_in"})
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.default_rng(1)
+    B, T, N = 3, 4, 3
+    em = rng.standard_normal((B, T, N)).astype(np.float32)
+    w = (rng.standard_normal((N + 2, N)) * 0.5).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        blk = main.global_block
+        blk.create_var(name="em", shape=em.shape, dtype="float32",
+                       is_data=True)
+        blk.create_var(name="w", shape=w.shape, dtype="float32",
+                       is_data=True)
+        blk.create_var(name="path", shape=(), dtype="int64")
+        blk.append_op("crf_decoding", {"Emission": ["em"],
+                                       "Transition": ["w"]},
+                      {"ViterbiPath": ["path"]}, {})
+    exe = pt.Executor()
+    exe.run(startup)
+    (path,) = exe.run(main, feed={"em": em, "w": w}, fetch_list=["path"])
+    path = np.asarray(path)
+    start, stop, trans = w[0], w[1], w[2:]
+    for b in range(B):
+        best, best_s = None, -np.inf
+        for p in itertools.product(range(N), repeat=T):
+            s = start[p[0]] + em[b, 0, p[0]]
+            for t in range(1, T):
+                s += trans[p[t - 1], p[t]] + em[b, t, p[t]]
+            s += stop[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(path[b], best)
+
+
+def test_crf_tagging_end_to_end():
+    """Sequence tagging where the LABEL DEPENDS ON THE PREVIOUS TAG (parity
+    chain): CRF training must learn the transition structure, beating the
+    emission-only decoder. Also exercises the Length-masked path."""
+    rng = np.random.default_rng(2)
+    B, T, N, D = 64, 6, 2, 5
+    # observations weakly indicate the tag; tags alternate with prob 0.9
+    tags = np.zeros((B, T), np.int64)
+    for b in range(B):
+        t0 = rng.integers(0, N)
+        tags[b, 0] = t0
+        for t in range(1, T):
+            tags[b, t] = (tags[b, t - 1] + 1) % N if rng.random() < 0.9 \
+                else tags[b, t - 1]
+    obs = (np.eye(N)[tags] @ rng.standard_normal((N, D)) * 0.3
+           + rng.standard_normal((B, T, D)) * 0.5).astype(np.float32)
+    lens = np.full((B,), T, np.int64)
+
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 7
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[T, D], dtype="float32")
+            y = L.data(name="y", shape=[T], dtype="int64")
+            ln = L.data(name="ln", shape=[1], dtype="int64")
+            em = L.fc(x, size=N, num_flatten_dims=2)
+            nll = L.linear_chain_crf(
+                em, y, param_attr=pt.ParamAttr(name="crfw"), length=ln)
+            loss = L.mean(nll)
+            pt.optimizer.Adam(0.05).minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            (lv,) = exe.run(main, feed={"x": obs, "y": tags, "ln": lens},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+        # the learned transition must favor the +1 alternation
+        w = np.asarray(pt.global_scope().find_var("crfw"))
+        trans = w[2:]
+        assert trans[0, 1] > trans[0, 0] and trans[1, 0] > trans[1, 1], trans

@@ -31,3 +31,53 @@ def test_model_average_and_lookahead():
     back = np.asarray(pt.global_scope().find_var("f.w_0"))
     assert not np.allclose(avg, cur)      # averaged weights differ
     np.testing.assert_allclose(back, cur)  # restored on exit
+
+
+def test_sparse_adam_lazy_mode():
+    """Adam with SelectedRows grads (reference adam_op.h SparseAdamFunctor,
+    lazy_mode): touched rows update exactly like dense Adam on those rows;
+    untouched rows keep params AND moments frozen (no decay)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers as L
+
+    def run(sparse):
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 5
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                ids = L.data(name="ids", shape=[3], dtype="int64")
+                y = L.data(name="y", shape=[1], dtype="float32")
+                emb = L.embedding(ids, size=[20, 4], is_sparse=sparse,
+                                  param_attr=pt.ParamAttr(name="tbl"))
+                pred = L.fc(L.reduce_sum(emb, dim=1), size=1)
+                loss = L.mean(L.square_error_cost(pred, y))
+                pt.optimizer.Adam(0.05).minimize(loss)
+        scope = pt.Scope()
+        exe = pt.Executor()
+        rng = np.random.default_rng(0)
+        idv = rng.integers(0, 10, (8, 3)).astype(np.int64)  # rows 10+ untouched
+        yv = rng.standard_normal((8, 1)).astype(np.float32)
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            t0 = np.asarray(scope.find_var("tbl")).copy()
+            for _ in range(5):
+                exe.run(main, feed={"ids": idv, "y": yv}, fetch_list=[loss])
+            t1 = np.asarray(scope.find_var("tbl"))
+            m1 = np.asarray(scope.find_var(
+                next(n for n in scope.var_names()
+                     if n.startswith("tbl") and "moment1" in n)))
+        return t0, t1, m1, idv
+
+    t0s, t1s, m1s, idv = run(sparse=True)
+    t0d, t1d, m1d, _ = run(sparse=False)
+    touched = np.zeros(20, bool)
+    touched[np.unique(idv)] = True
+    # dense and lazy-sparse agree on touched rows (same math there)
+    np.testing.assert_allclose(t1s[touched], t1d[touched], rtol=1e-5,
+                               atol=1e-6)
+    # lazy mode: untouched rows completely frozen
+    np.testing.assert_array_equal(t1s[~touched], t0s[~touched])
+    np.testing.assert_array_equal(m1s[~touched], 0.0)
+    # dense mode moved nothing either on untouched rows (zero grads), but
+    # the sparse path must have moved touched rows off init
+    assert not np.allclose(t1s[touched], t0s[touched])
