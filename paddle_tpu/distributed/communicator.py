@@ -58,6 +58,7 @@ class Communicator:
         self._running = False
         self._grads_sent = 0
         self._lock = threading.Lock()
+        self._send_errors: dict[str, Exception] = {}
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -97,13 +98,15 @@ class Communicator:
         if err is not None:
             raise RuntimeError(
                 f"Communicator recv thread failed: {err}") from err
-        err = getattr(self, "_send_error", None)
-        if err is not None:
+        if self._send_errors:
             # a failure on the run's FINAL batches has no later push() to
             # surface through — the tail gradients were lost
+            detail = "; ".join(
+                f"'{n}': {e}" for n, e in self._send_errors.items())
+            err = next(iter(self._send_errors.values()))
             raise RuntimeError(
-                f"Communicator send thread failed (tail gradients "
-                f"dropped): {err}") from err
+                f"Communicator send thread(s) failed (tail gradients "
+                f"dropped): {detail}") from err
         # one final parameter pull so the trainer scope holds the servers'
         # latest state when training ends
         self._recv_all()
@@ -119,10 +122,11 @@ class Communicator:
         send-thread failure instead of blocking forever behind it."""
         q = self._queues[name]
         while True:
-            err = getattr(self, "_send_error", None)
+            err = self._send_errors.get(name)
             if err is not None:
                 raise RuntimeError(
-                    f"Communicator send thread failed: {err}") from err
+                    f"Communicator send thread for '{name}' failed: "
+                    f"{err}") from err
             try:
                 q.put(value, timeout=1.0)
                 return
@@ -149,12 +153,15 @@ class Communicator:
                     time.sleep(0.002)
             try:
                 self._send_merged(name, ctx, batch)
-                self._send_error = None  # transient failures don't poison
+                # transient failures don't poison — but only THIS grad's
+                # success clears its entry; another grad's healthy sends
+                # must not mask a broken one
+                self._send_errors.pop(name, None)
             except Exception as e:
                 # a dead send thread would silently jam the queue and block
                 # every future push() — survive, drop the batch, record the
-                # error so push() can surface it (cleared on next success)
-                self._send_error = e
+                # error per-gradient so push() can surface it
+                self._send_errors[name] = e
             finally:
                 for _ in batch:
                     q.task_done()

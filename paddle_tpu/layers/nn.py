@@ -1226,7 +1226,7 @@ def elu(x, alpha=1.0, name=None):
 
 
 def relu6(x, threshold=6.0, name=None):
-    return _simple_op("relu6", {"X": [x]})
+    return _simple_op("relu6", {"X": [x]}, {"threshold": threshold})
 
 
 def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
@@ -1235,7 +1235,9 @@ def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
 
 
 def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
-    return _simple_op("hard_swish", {"X": [x]})
+    return _simple_op("hard_swish", {"X": [x]},
+                      {"threshold": threshold, "scale": scale,
+                       "offset": offset})
 
 
 def swish(x, beta=1.0, name=None):
@@ -1375,25 +1377,31 @@ def mean_iou(input, label, num_classes):
 
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None,
-                    actual_shape=None, align_corners=False, align_mode=1):
+                    actual_shape=None, align_corners=True, align_mode=1):
     oh, ow = (out_shape or (0, 0))
     return _simple_op("bilinear_interp", {"X": [input]},
-                      {"out_h": oh, "out_w": ow, "scale": scale or 0.0})
+                      {"out_h": oh, "out_w": ow, "scale": scale or 0.0,
+                       "align_corners": align_corners,
+                       "align_mode": align_mode})
 
 
 def resize_nearest(input, out_shape=None, scale=None, name=None,
-                   actual_shape=None, align_corners=False):
+                   actual_shape=None, align_corners=True):
     oh, ow = (out_shape or (0, 0))
     return _simple_op("nearest_interp", {"X": [input]},
-                      {"out_h": oh, "out_w": ow, "scale": scale or 0.0})
+                      {"out_h": oh, "out_w": ow, "scale": scale or 0.0,
+                       "align_corners": align_corners})
 
 
 def image_resize(input, out_shape=None, scale=None, name=None,
                  resample="BILINEAR", actual_shape=None,
-                 align_corners=False, align_mode=1):
+                 align_corners=True, align_mode=1):
     if resample.upper() == "NEAREST":
-        return resize_nearest(input, out_shape, scale, name)
-    return resize_bilinear(input, out_shape, scale, name)
+        return resize_nearest(input, out_shape, scale, name,
+                              align_corners=align_corners)
+    return resize_bilinear(input, out_shape, scale, name,
+                           align_corners=align_corners,
+                           align_mode=align_mode)
 
 
 def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,

@@ -32,7 +32,11 @@ register_op("reciprocal")(_act(lambda x: 1.0 / x))
 register_op("softplus")(_act(jax.nn.softplus))
 register_op("softsign")(_act(lambda x: x / (1.0 + jnp.abs(x))))
 register_op("gelu")(_act(lambda x: jax.nn.gelu(x, approximate=False)))
-register_op("relu6")(_act(lambda x: jnp.clip(x, 0.0, 6.0)))
+@register_op("relu6")
+def _relu6(ctx):
+    x = ctx.input("X")
+    t = jnp.asarray(ctx.attr("threshold", 6.0), x.dtype)
+    return {"Out": jnp.clip(x, 0.0, t)}
 register_op("ceil", no_grad=True)(_act(jnp.ceil))
 register_op("floor", no_grad=True)(_act(jnp.floor))
 register_op("round", no_grad=True)(_act(jnp.round))

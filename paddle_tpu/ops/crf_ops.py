@@ -106,7 +106,7 @@ def crf_decoding(ctx: ExecContext):
 
         def back(tag, bp):
             prev = bp[tag]
-            return prev, tag
+            return prev, prev
 
         _, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
         path = jnp.concatenate([path_rev, last_tag[None]])

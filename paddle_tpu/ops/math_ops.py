@@ -268,7 +268,7 @@ def rank_loss(ctx: ExecContext):
     label = ctx.input("Label")
     left, right = ctx.input("Left"), ctx.input("Right")
     d = left - right
-    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+    return {"Out": jnp.logaddexp(0.0, d) - label * d}
 
 
 @register_op("margin_rank_loss")

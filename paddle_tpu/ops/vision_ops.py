@@ -17,8 +17,7 @@ import numpy as np
 from .registry import ExecContext, register_op
 
 
-def _resize(ctx, method):
-    x = ctx.input("X")  # [N, C, H, W]
+def _resize_dims(ctx, x):
     out_h = int(ctx.attr("out_h", 0))
     out_w = int(ctx.attr("out_w", 0))
     scale = float(ctx.attr("scale", 0.0) or 0.0)
@@ -27,15 +26,65 @@ def _resize(ctx, method):
             raise ValueError("resize needs out_h/out_w or scale")
         out_h = int(x.shape[2] * scale)
         out_w = int(x.shape[3] * scale)
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w),
-                           method=method)
+    return out_h, out_w
+
+
+def _src_coords(out_len, in_len, align_corners, align_mode):
+    """Source sampling coordinate per interpolate_op.h: align_corners ->
+    d*(in-1)/(out-1); else mode 0 half-pixel (d+.5)*r-.5, mode 1 d*r."""
+    d = jnp.arange(out_len, dtype=jnp.float32)
+    if align_corners:
+        r = (in_len - 1) / max(out_len - 1, 1)
+        return d * r
+    r = in_len / out_len
+    if int(align_mode) == 0:
+        return jnp.maximum((d + 0.5) * r - 0.5, 0.0)
+    return d * r
+
+
+def _resize(ctx, method):
+    x = ctx.input("X")  # [N, C, H, W]
+    out_h, out_w = _resize_dims(ctx, x)
+    align_corners = bool(ctx.attr("align_corners", False))
+    align_mode = int(ctx.attr("align_mode", 1))
+    H, W = x.shape[2], x.shape[3]
+    if method == "nearest":
+        if align_corners:
+            # reference: static_cast<int>(ratio*k + 0.5) — round half UP
+            iy = jnp.floor(_src_coords(out_h, H, True, 0) + 0.5)
+            ix = jnp.floor(_src_coords(out_w, W, True, 0) + 0.5)
+        else:
+            # reference: floor(k * in/out) — NOT half-pixel
+            iy = jnp.floor(_src_coords(out_h, H, False, 1))
+            ix = jnp.floor(_src_coords(out_w, W, False, 1))
+        iy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        ix = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        return {"Out": x[:, :, iy][:, :, :, ix]}
+    if not align_corners and int(align_mode) == 0:
+        # jax.image 'linear' is the half-pixel convention; antialias would
+        # low-pass on downscale, which the point-sampled reference never does
+        out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w),
+                               method="linear", antialias=False)
+        return {"Out": out.astype(x.dtype)}
+    f = x.astype(jnp.float32)
+    sy = _src_coords(out_h, H, align_corners, align_mode)
+    sx = _src_coords(out_w, W, align_corners, align_mode)
+    y0 = jnp.clip(jnp.floor(sy).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(sx).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (sy - y0)[None, None, :, None]
+    wx = (sx - x0)[None, None, None, :]
+    top = f[:, :, y0][:, :, :, x0] * (1 - wx) + f[:, :, y0][:, :, :, x1] * wx
+    bot = f[:, :, y1][:, :, :, x0] * (1 - wx) + f[:, :, y1][:, :, :, x1] * wx
+    out = top * (1 - wy) + bot * wy
     return {"Out": out.astype(x.dtype)}
 
 
 @register_op("bilinear_interp")
 def bilinear_interp(ctx: ExecContext):
-    """reference interpolate_op.* bilinear path (align_corners=False form:
-    jax.image 'linear' half-pixel convention)."""
+    """reference interpolate_op.* bilinear path, all three coordinate
+    conventions (align_corners, align_mode 0/1)."""
     return _resize(ctx, "linear")
 
 

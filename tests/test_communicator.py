@@ -157,15 +157,15 @@ def test_push_surfaces_send_thread_failure():
         comm = Communicator({"g": {"epmap": ["ep0"], "sections": []}}, {},
                             Exploding(), pt.Scope())
         comm.start()
-        with pytest.raises(RuntimeError, match="send thread failed"):
+        with pytest.raises(RuntimeError, match="send thread.*failed"):
             for _ in range(50):
                 comm.push("g", np.zeros((2,), np.float32))
                 time.sleep(0.01)
         # stop() must ALSO surface the failure (tail batches with no later
         # push to report through)
-        with pytest.raises(RuntimeError, match="send thread failed"):
+        with pytest.raises(RuntimeError, match="send thread.*failed"):
             comm.stop()
-        comm._send_error = None
+        comm._send_errors.clear()
         comm._running = False
     finally:
         flags.set_flags({"communicator_send_queue_size": old})

@@ -99,7 +99,7 @@ def test_vision_layout_wrappers():
     np.testing.assert_allclose(
         outs[4], x.reshape(2, 8, 2, 2, 2, 2).mean(axis=(3, 5)), rtol=1e-5)
     assert outs[5].shape == (2, 8, 8, 8)
-    np.testing.assert_allclose(outs[6], x[:, :, 1::2, 1::2])  # nearest half-pixel
+    np.testing.assert_allclose(outs[6], x[:, :, ::3, ::3])  # nearest align_corners (reference default)
     assert outs[7].shape == x.shape
     assert outs[8].shape == x.shape
 
@@ -199,3 +199,68 @@ def test_unfold_matches_manual_im2col():
     ref0 = np.stack([x[0, :, 0, 0], x[0, :, 0, 1],
                      x[0, :, 1, 0], x[0, :, 1, 1]], axis=1).reshape(-1)
     np.testing.assert_allclose(out[0, :, 0], ref0)
+
+
+def test_resize_align_corners_conventions():
+    """interpolate_op.h coordinate conventions: align_corners=True maps
+    d*(in-1)/(out-1); False+mode0 is half-pixel; False+mode1 is d*in/out."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build():
+        v = L.data(name="x", shape=[1, 4, 4], dtype="float32")
+        return [L.resize_bilinear(v, out_shape=(7, 7), align_corners=True),
+                L.resize_bilinear(v, out_shape=(7, 7), align_corners=False,
+                                  align_mode=0),
+                L.resize_bilinear(v, out_shape=(7, 7), align_corners=False,
+                                  align_mode=1),
+                L.resize_nearest(v, out_shape=(2, 2), align_corners=True),
+                L.resize_nearest(v, out_shape=(2, 2), align_corners=False),
+                L.resize_nearest(v, out_shape=(3, 3), align_corners=True)]
+
+    a_true, a_m0, a_m1, near, near_f, near_half = _run(
+        build, {"x": x}, n_fetch=6)
+
+    def bilinear(coords):
+        out = np.zeros((7, 7), np.float32)
+        img = x[0, 0]
+        for i, sy in enumerate(coords):
+            for j, sx in enumerate(coords):
+                y0, x0 = min(int(sy), 3), min(int(sx), 3)
+                y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+                wy, wx = sy - y0, sx - x0
+                out[i, j] = (img[y0, x0] * (1 - wy) * (1 - wx)
+                             + img[y0, x1] * (1 - wy) * wx
+                             + img[y1, x0] * wy * (1 - wx)
+                             + img[y1, x1] * wy * wx)
+        return out
+
+    d = np.arange(7, dtype=np.float64)
+    np.testing.assert_allclose(a_true[0, 0], bilinear(d * 3 / 6), rtol=1e-5)
+    np.testing.assert_allclose(
+        a_m0[0, 0], bilinear(np.maximum((d + 0.5) * 4 / 7 - 0.5, 0)),
+        rtol=1e-5)
+    np.testing.assert_allclose(a_m1[0, 0], bilinear(d * 4 / 7), rtol=1e-5)
+    # nearest align_corners: round(d * 3 / 1) -> rows/cols {0, 3}
+    np.testing.assert_allclose(near[0, 0], x[0, 0][::3, ::3])
+    # nearest NOT aligned: floor(d * in/out) -> {0, 2}, never half-pixel
+    np.testing.assert_allclose(near_f[0, 0], x[0, 0][::2, ::2])
+    # aligned 4->3: coords d*3/2 = [0, 1.5, 3]; half-up rounds 1.5 -> 2
+    np.testing.assert_allclose(
+        near_half[0, 0], x[0, 0][[0, 2, 3]][:, [0, 2, 3]])
+
+
+def test_rank_loss_stable_at_large_margin():
+    """logaddexp form must not overflow where log1p(exp(d)) would (d>88)."""
+    left = np.array([[200.0]], np.float32)
+    right = np.array([[0.0]], np.float32)
+    lab = np.array([[1.0]], np.float32)
+
+    def build():
+        l = L.data(name="l", shape=[1], dtype="float32")
+        r = L.data(name="r", shape=[1], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="float32")
+        return L.rank_loss(y, l, r)
+
+    out, = _run(build, {"l": left, "r": right, "y": lab})
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.0, atol=1e-4)  # log(1+e^200)-200 ~ 0
