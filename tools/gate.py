@@ -1,0 +1,49 @@
+"""Pre-snapshot gate: the round may not end on a red suite (VERDICT r3 #3).
+
+Runs the full pytest suite plus the single-chip compile check and exits
+non-zero on ANY failure, printing the failing node ids. Run it before every
+end-of-round snapshot commit:
+
+    python tools/gate.py          # full gate (suite + graft entry)
+    python tools/gate.py --fast   # suite only
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_suite() -> int:
+    print("[gate] running test suite ...", flush=True)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "--tb=line"],
+        cwd=REPO)
+    if r.returncode != 0:
+        print("[gate] FAIL: test suite is red — do not snapshot", flush=True)
+    return r.returncode
+
+
+def run_entry() -> int:
+    print("[gate] compile-checking __graft_entry__.entry() ...", flush=True)
+    code = ("import __graft_entry__ as g; fn, args = g.entry(); "
+            "import jax; jax.eval_shape(fn, *args); print('entry ok')")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO)
+    if r.returncode != 0:
+        print("[gate] FAIL: graft entry does not compile", flush=True)
+    return r.returncode
+
+
+def main() -> int:
+    rc = run_suite()
+    if "--fast" not in sys.argv:
+        rc = rc or run_entry()
+    if rc == 0:
+        print("[gate] OK — green suite, safe to snapshot")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
