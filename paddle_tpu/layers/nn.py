@@ -14,8 +14,44 @@ from ..core.types import DType
 from ..framework import Variable
 from ..initializer import Constant, Normal, Xavier
 from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
 
 __all__ = [
+    "uniform_random_batch_size_like",
+    "row_conv",
+    "spectral_norm",
+    "data_norm",
+    "center_loss",
+    "npair_loss",
+    "teacher_student_sigmoid_loss",
+    "cross_entropy2",
+    "sampled_softmax_with_cross_entropy",
+    "unique",
+    "unique_with_counts",
+    "hash",
+    "continuous_value_model",
+    "merge_selected_rows",
+    "get_tensor_from_selected_rows",
+    "filter_by_instag",
+    "autoincreased_step_counter",
+    "py_func",
+    "lstm_unit",
+    "lstm",
+    "dynamic_lstmp",
+    "edit_distance",
+    "ctc_greedy_decoder",
+    "chunk_eval",
+    "match_matrix_tensor",
+    "tree_conv",
+    "affine_grid",
+    "im2sequence",
+    "random_crop",
+    "resize_trilinear",
+    "image_resize_short",
+    "conv3d_transpose",
+    "adaptive_pool3d",
+    "deformable_conv",
+    "gaussian_random_batch_size_like",
     "Print",
     "linear_chain_crf",
     "crf_decoding",
@@ -1628,4 +1664,650 @@ def crf_decoding(input, param_attr, label=None, length=None):
     if length is not None:
         inputs["Length"] = [length]
     helper.append_op("crf_decoding", inputs, {"ViterbiPath": [out]}, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Round-4 layers-DSL tail (reference nn.py parity batch)
+# ---------------------------------------------------------------------------
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference nn.py row_conv / row_conv_op.cc: lookahead convolution.
+    input [B, T, D]; filter [future_context_size+1, D]."""
+    helper = LayerHelper("row_conv")
+    dtype = input.dtype
+    filt = helper.create_parameter(
+        param_attr, [future_context_size + 1, input.shape[-1]], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("row_conv", {"X": [input], "Filter": [filt]},
+                     {"Out": [out]}, {})
+    return helper.append_activation(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference nn.py spectral_norm / spectral_norm_op.*."""
+    helper = LayerHelper("spectral_norm", name=name)
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w = 1
+    for i, d in enumerate(weight.shape):
+        if i != dim:
+            w *= d
+    from ..initializer import Normal
+
+    u = helper.create_parameter(
+        ParamAttr(name=helper.name + ".u", trainable=False,
+                  initializer=Normal(0.0, 1.0)), [h], dtype)
+    v = helper.create_parameter(
+        ParamAttr(name=helper.name + ".v", trainable=False,
+                  initializer=Normal(0.0, 1.0)), [w], dtype)
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "spectral_norm", {"Weight": [weight], "U": [u], "V": [v]},
+        {"Out": [out], "UOut": [u], "VOut": [v]},
+        {"dim": int(dim), "power_iters": int(power_iters), "eps": float(eps)})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """reference nn.py data_norm: normalization from accumulated batch
+    counters (CTR models where per-batch stats are too noisy)."""
+    helper = LayerHelper("data_norm", name=name)
+    dtype = input.dtype
+    C = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    defaults = {"batch_size": 1e4, "batch_sum": 0.0, "batch_square": 1e4}
+    if isinstance(param_attr, dict):
+        defaults.update({k: param_attr.get(k, v)
+                         for k, v in defaults.items()})
+    bsize = helper.create_parameter(
+        ParamAttr(name=helper.name + ".batch_size",
+                  initializer=Constant(float(defaults["batch_size"]))),
+        [C], dtype)
+    bsum = helper.create_parameter(
+        ParamAttr(name=helper.name + ".batch_sum",
+                  initializer=Constant(float(defaults["batch_sum"]))),
+        [C], dtype)
+    bsq = helper.create_parameter(
+        ParamAttr(name=helper.name + ".batch_square_sum",
+                  initializer=Constant(float(defaults["batch_square"]))),
+        [C], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    means = helper.create_variable_for_type_inference(dtype)
+    scales = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "data_norm",
+        {"X": [input], "BatchSize": [bsize], "BatchSum": [bsum],
+         "BatchSquareSum": [bsq]},
+        {"Y": [out], "Means": [means], "Scales": [scales]},
+        {"epsilon": float(epsilon), "data_layout": data_layout})
+    return helper.append_activation(out, act)
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """reference nn.py center_loss / center_loss_op.h."""
+    helper = LayerHelper("center_loss")
+    dtype = input.dtype
+    centers = helper.create_parameter(
+        param_attr, [num_classes, input.shape[-1]], dtype)
+    centers.stop_gradient = True
+    from .tensor import fill_constant
+
+    if not hasattr(alpha, "name"):
+        alpha = fill_constant([1], "float32", float(alpha))
+    loss = helper.create_variable_for_type_inference(dtype)
+    diff = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "center_loss",
+        {"X": [input], "Label": [label], "Centers": [centers],
+         "CenterUpdateRate": [alpha]},
+        {"Loss": [loss], "SampleCenterDiff": [diff], "CentersOut": [centers]},
+        {"need_update": bool(update_center)})
+    return loss
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference nn.py npair_loss — composed from the same primitives as the
+    reference (no bespoke op): soft-target CE over anchor@positive^T
+    similarities (targets from label equality, row-normalized) + L2."""
+    from .control_flow import equal
+
+    B = labels.shape[0]
+    lab = reshape(labels, [B, 1])
+    lab = expand(lab, [1, B])
+    same = cast(equal(lab, transpose(lab, [1, 0])), "float32")
+    target = elementwise_div(
+        same, reduce_sum(same, dim=1, keep_dim=True))
+    l2 = scale(
+        elementwise_add(
+            reduce_mean(reduce_sum(square(anchor), dim=1)),
+            reduce_mean(reduce_sum(square(positive), dim=1))),
+        scale=l2_reg * 0.25)
+    sim = matmul(anchor, positive, transpose_y=True)
+    ce = softmax_with_cross_entropy(sim, target, soft_label=True)
+    return elementwise_add(reduce_mean(ce), l2)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _simple_op("teacher_student_sigmoid_loss",
+                      {"X": [input], "Label": [label]},
+                      {"soft_max_up_bound": float(soft_max_up_bound),
+                       "soft_max_lower_bound": float(soft_max_lower_bound)},
+                      out_slot="Y")
+
+
+def cross_entropy2(input, label, name=None, ignore_index=-100):
+    helper = LayerHelper("cross_entropy2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    match = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cross_entropy2", {"X": [input], "Label": [label]},
+                     {"Y": [out], "MatchX": [match], "XShape": [xshape]},
+                     {"ignore_index": ignore_index})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference nn.py sampled_softmax_with_cross_entropy: sample_logits op
+    + full softmax CE over the sampled vocabulary / num_true."""
+    if use_customized_samples:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: use_customized_samples is "
+            "not supported (only the log-uniform sampler)")
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference("int64")
+    probabilities = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_logits = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "sample_logits", {"Logits": [logits], "Labels": [label]},
+        {"Samples": [samples], "SampledLogits": [sampled_logits],
+         "SampledLabel": [sampled_label], "Probabilities": [probabilities]},
+        {"num_samples": int(num_samples),
+         "remove_accidental_hits": bool(remove_accidental_hits),
+         "seed": int(seed)})
+    loss = softmax_with_cross_entropy(sampled_logits, sampled_label)
+    return scale(loss, scale=1.0 / num_true)
+
+
+def unique(x, dtype="int32"):
+    """reference nn.py unique: host op (data-dependent output extent)."""
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype,
+                                                    stop_gradient=True)
+    index = helper.create_variable_for_type_inference(dtype,
+                                                      stop_gradient=True)
+    helper.append_op("unique", {"X": [x]}, {"Out": [out], "Index": [index]},
+                     {"dtype": dtype})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype,
+                                                    stop_gradient=True)
+    index = helper.create_variable_for_type_inference(dtype,
+                                                      stop_gradient=True)
+    count = helper.create_variable_for_type_inference("int64",
+                                                      stop_gradient=True)
+    helper.append_op("unique_with_counts", {"X": [x]},
+                     {"Out": [out], "Index": [index], "Count": [count]},
+                     {"dtype": dtype})
+    return out, index, count
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    helper.append_op("hash", {"X": [input]}, {"Out": [out]},
+                     {"num_hash": int(num_hash), "mod_by": int(hash_size)})
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cvm", {"X": [input], "CVM": [cvm]}, {"Y": [out]},
+                     {"use_cvm": bool(use_cvm)})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    return _simple_op("merge_selected_rows", {"X": [x]})
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _simple_op("get_tensor_from_selected_rows", {"X": [x]})
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference("float32")
+    mmap = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "filter_by_instag",
+        {"Ins": [ins], "Ins_tag": [ins_tag], "Filter_tag": [filter_tag]},
+        {"Out": [out], "LossWeight": [loss_weight], "IndexMap": [mmap]},
+        {"is_lod": bool(is_lod)})
+    return out, loss_weight
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference nn.py autoincreased_step_counter: persistable int64 counter
+    incremented once per executor run."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    counter = helper.create_or_get_global_variable(
+        name=name, shape=[1], dtype="int64", persistable=True,
+        initializer=Constant(float(begin - step)))
+    helper.append_op("increment", {"X": [counter]}, {"Out": [counter]},
+                     {"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference nn.py py_func / py_func_op.cc: run a user Python callable
+    as a HOST op inside the program. `out` variables must be pre-created
+    (their shapes/dtypes are the user's contract, like the reference)."""
+    from ..ops.tensor_ops import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fwd_id = register_py_func(func)
+    bwd_id = register_py_func(backward_func) if backward_func else -1
+    skip = skip_vars_in_backward_input or []
+    skip_names = [v if isinstance(v, str) else v.name
+                  for v in (skip if isinstance(skip, (list, tuple))
+                            else [skip])]
+    helper.append_op(
+        "py_func", {"X": list(xs)}, {"Out": list(outs)},
+        {"forward_callable_id": fwd_id, "backward_callable_id": bwd_id,
+         "skip_names": skip_names})
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference nn.py lstm_unit: fc([x, h]) -> 4H gates -> lstm_unit op.
+    Returns (hidden_t, cell_t)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    H = hidden_t_prev.shape[-1]
+    concat_in = concat([x_t, hidden_t_prev], axis=-1)
+    fc_out = fc(concat_in, size=4 * H, param_attr=param_attr,
+                bias_attr=bias_attr)
+    hidden = helper.create_variable_for_type_inference(x_t.dtype)
+    cell = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        "lstm_unit", {"X": [fc_out], "C_prev": [cell_t_prev]},
+        {"H": [hidden], "C": [cell]}, {"forget_bias": float(forget_bias)})
+    return hidden, cell
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference nn.py lstm (the cudnn_lstm path): stacked/bidirectional
+    LSTM over [B, T, D]. Returns (rnn_out, last_h, last_c)."""
+    helper = LayerHelper("cudnn_lstm", name=name)
+    dtype = input.dtype
+    D = input.shape[-1]
+    dirs = 2 if is_bidirec else 1
+    n_w = 0
+    for layer in range(num_layers):
+        in_dim = D if layer == 0 else hidden_size * dirs
+        n_w += dirs * (in_dim * 4 * hidden_size
+                       + hidden_size * 4 * hidden_size + 4 * hidden_size)
+    w = helper.create_parameter(
+        ParamAttr(name=helper.name + ".w"), [n_w], dtype,
+        default_initializer=default_initializer)
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "cudnn_lstm",
+        {"Input": [input], "W": [w], "InitH": [init_h], "InitC": [init_c]},
+        {"Out": [out], "LastH": [last_h], "LastC": [last_c]},
+        {"num_layers": int(num_layers), "hidden_size": int(hidden_size),
+         "is_bidirec": bool(is_bidirec), "dropout_prob": float(dropout_prob),
+         "is_test": bool(is_test), "seed": int(seed)})
+    return out, last_h, last_c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """reference nn.py dynamic_lstmp / lstmp_op.cc: LSTM with a learned
+    projection on the recurrent path. input [B, T, 4H] pre-projected; size
+    is 4*H like dynamic_lstm. Returns (projection [B,T,P], cell [B,T,H])."""
+    if use_peepholes:
+        raise NotImplementedError(
+            "dynamic_lstmp: peephole connections are not implemented "
+            "(reference default use_peepholes=True differs; pass False)")
+    H = size // 4
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    weight = helper.create_parameter(param_attr, [proj_size, 4 * H], dtype)
+    proj_weight = helper.create_parameter(
+        ParamAttr(name=helper.name + ".proj_w"), [H, proj_size], dtype)
+    bias = helper.create_parameter(bias_attr, [1, 4 * H], dtype,
+                                   is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [weight],
+           "ProjWeight": [proj_weight]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    helper.append_op(
+        "lstmp", ins, {"Projection": [proj], "Cell": [cell]},
+        {"is_reverse": bool(is_reverse),
+         "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation,
+         "proj_activation": proj_activation})
+    return proj, cell
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """reference nn.py edit_distance: Levenshtein distance on padded int
+    sequences. Returns (distance [B,1] float32, sequence_num [1])."""
+    helper = LayerHelper("edit_distance")
+    if ignored_tokens:
+        erased_in = helper.create_variable_for_type_inference("int64")
+        erased_in_len = helper.create_variable_for_type_inference("int64")
+        ins = {"X": [input]}
+        if input_length is not None:
+            ins["Length"] = [input_length]
+        helper.append_op("sequence_erase", ins,
+                         {"Out": [erased_in], "OutLength": [erased_in_len]},
+                         {"tokens": list(ignored_tokens)})
+        input, input_length = erased_in, erased_in_len
+        erased_lab = helper.create_variable_for_type_inference("int64")
+        erased_lab_len = helper.create_variable_for_type_inference("int64")
+        ins = {"X": [label]}
+        if label_length is not None:
+            ins["Length"] = [label_length]
+        helper.append_op("sequence_erase", ins,
+                         {"Out": [erased_lab], "OutLength": [erased_lab_len]},
+                         {"tokens": list(ignored_tokens)})
+        label, label_length = erased_lab, erased_lab_len
+    dist = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op("edit_distance", ins,
+                     {"Out": [dist], "SequenceNum": [seq_num]},
+                     {"normalized": bool(normalized)})
+    return dist, seq_num
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=-1,
+                       name=None):
+    """reference nn.py ctc_greedy_decoder: argmax -> merge repeats -> drop
+    blanks (ctc_align op). input [B, T, V] probs; returns decoded [B, T]
+    padded with -1 (+ the decode lengths when input_length given)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    am = helper.create_variable_for_type_inference("int64",
+                                                   stop_gradient=True)
+    helper.append_op("arg_max", {"X": [input]}, {"Out": [am]}, {"axis": -1})
+    out = helper.create_variable_for_type_inference("int64",
+                                                    stop_gradient=True)
+    out_len = helper.create_variable_for_type_inference("int64",
+                                                        stop_gradient=True)
+    ins = {"Input": [am]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    helper.append_op("ctc_align", ins,
+                     {"Output": [out], "OutputLength": [out_len]},
+                     {"blank": int(blank),
+                      "padding_value": int(padding_value)})
+    if input_length is None:
+        return out
+    return out, out_len
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference nn.py chunk_eval / chunk_eval_op.cc."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    n_infer = helper.create_variable_for_type_inference("int64")
+    n_label = helper.create_variable_for_type_inference("int64")
+    n_correct = helper.create_variable_for_type_inference("int64")
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op(
+        "chunk_eval", ins,
+        {"Precision": [precision], "Recall": [recall], "F1-Score": [f1],
+         "NumInferChunks": [n_infer], "NumLabelChunks": [n_label],
+         "NumCorrectChunks": [n_correct]},
+        {"chunk_scheme": chunk_scheme,
+         "num_chunk_types": int(num_chunk_types),
+         "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return precision, recall, f1, n_infer, n_label, n_correct
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, x_length=None,
+                        y_length=None):
+    """reference nn.py match_matrix_tensor: out[b,c,i,j] = x_i^T W_c y_j.
+    Padded design: x [B, Tx, H], y [B, Ty, H] -> out [B, C, Tx, Ty]."""
+    helper = LayerHelper("match_matrix_tensor", name=name)
+    H = x.shape[-1]
+    w = helper.create_parameter(param_attr, [H, channel_num, H], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"X": [x], "Y": [y], "W": [w]}
+    if x_length is not None:
+        ins["XLength"] = [x_length]
+    if y_length is not None:
+        ins["YLength"] = [y_length]
+    helper.append_op("match_matrix_tensor", ins, {"Out": [out]}, {})
+    return helper.append_activation(out, act), w
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference nn.py tree_conv (TBCNN) / tree_conv_op.*."""
+    helper = LayerHelper("tree_conv", name=name)
+    dtype = nodes_vector.dtype
+    F = nodes_vector.shape[2]
+    w = helper.create_parameter(param_attr,
+                                [F, 3, output_size, num_filters], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "tree_conv",
+        {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+         "Filter": [w]},
+        {"Out": [out]}, {"max_depth": int(max_depth)})
+    if bias_attr:
+        out = helper.append_bias_op(out, bias_attr)
+    return helper.append_activation(out, act)
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    shape = list(out_shape) if not hasattr(out_shape, "name") else None
+    if shape is None:
+        raise NotImplementedError(
+            "affine_grid: out_shape must be a static list under XLA")
+    helper.append_op("affine_grid", {"Theta": [theta]}, {"Output": [out]},
+                     {"output_shape": [int(s) for s in shape]})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """reference nn.py im2sequence: sliding-window im2col. Padded design
+    returns [B, n_windows, C*kh*kw] (the reference flattens the batch into
+    the LoD)."""
+    def _pair(v, n=2):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pad = _pair(padding, 4)
+    if len(pad) == 2:
+        pad = pad * 2
+    helper.append_op("im2sequence", {"X": [input]}, {"Out": [out]},
+                     {"kernels": _pair(filter_size),
+                      "strides": _pair(stride), "paddings": pad})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("random_crop", {"X": [x]}, {"Out": [out]},
+                     {"shape": [int(s) for s in shape],
+                      "seed": int(seed) if seed is not None else -1})
+    return out
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1):
+    helper = LayerHelper("trilinear_interp", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    od, oh, ow = (out_shape or (0, 0, 0))
+    helper.append_op("trilinear_interp", {"X": [input]}, {"Out": [out]},
+                     {"out_d": od, "out_h": oh, "out_w": ow,
+                      "scale": scale or 0.0,
+                      "align_corners": bool(align_corners),
+                      "align_mode": int(align_mode)})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference nn.py image_resize_short: scale so the SHORT side hits
+    out_short_len (static shapes: H, W known at build time)."""
+    H, W = input.shape[2], input.shape[3]
+    short = min(H, W)
+    out_shape = [int(round(H * out_short_len / short)),
+                 int(round(W * out_short_len / short))]
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference nn.py conv3d_transpose / conv_transpose_op.cc 3-D path."""
+    def trip(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    helper = LayerHelper("conv3d_transpose", name=name)
+    dtype = input.dtype
+    C = input.shape[1]
+    if filter_size is None:
+        raise ValueError("conv3d_transpose: filter_size is required "
+                         "(output_size-derived filters need dynamic shapes)")
+    k = trip(filter_size)
+    w = helper.create_parameter(
+        param_attr, [C, num_filters // groups] + k, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "conv3d_transpose", {"Input": [input], "Filter": [w]},
+        {"Output": [out]},
+        {"strides": trip(stride), "paddings": trip(padding),
+         "dilations": trip(dilation), "groups": int(groups)})
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, [num_filters], dtype,
+                                       is_bias=True)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("elementwise_add", {"X": [out], "Y": [bias]},
+                         {"Out": [tmp]}, {"axis": 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    return _simple_op("adaptive_pool3d", {"X": [input]},
+                      {"pooled_size": list(pool_size),
+                       "pooling_type": pool_type})
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=1, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    """reference nn.py deformable_conv / deformable_conv_op.* (v2)."""
+    def _pair(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 2
+
+    helper = LayerHelper("deformable_conv", name=name)
+    dtype = input.dtype
+    C = input.shape[1]
+    k = _pair(filter_size)
+    w = helper.create_parameter(
+        param_attr, [num_filters, C // groups] + k, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if mask is not None:
+        ins["Mask"] = [mask]
+    helper.append_op(
+        "deformable_conv", ins, {"Output": [out]},
+        {"strides": _pair(stride), "paddings": _pair(padding),
+         "dilations": _pair(dilation), "groups": int(groups),
+         "deformable_groups": int(deformable_groups),
+         "im2col_step": int(im2col_step)})
+    if bias_attr:
+        bias = helper.create_parameter(bias_attr, [num_filters], dtype,
+                                       is_bias=True)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op("elementwise_add", {"X": [out], "Y": [bias]},
+                         {"Out": [tmp]}, {"axis": 1})
+        out = tmp
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "gaussian_random_batch_size_like", {"Input": [input]},
+        {"Out": [out]},
+        {"shape": list(shape), "input_dim_idx": int(input_dim_idx),
+         "output_dim_idx": int(output_dim_idx), "mean": float(mean),
+         "std": float(std), "seed": int(seed), "dtype": dtype})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "uniform_random_batch_size_like", {"Input": [input]}, {"Out": [out]},
+        {"shape": list(shape), "input_dim_idx": int(input_dim_idx),
+         "output_dim_idx": int(output_dim_idx), "min": float(min),
+         "max": float(max), "seed": int(seed), "dtype": dtype})
     return out

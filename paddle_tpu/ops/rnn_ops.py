@@ -151,3 +151,135 @@ def lstm(ctx: ExecContext):
     (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
     return {"Hidden": jnp.swapaxes(hs, 0, 1),
             "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("lstmp")
+def lstmp(ctx: ExecContext):
+    """Projection LSTM (reference lstmp_op.cc / layers.dynamic_lstmp).
+    Input [B, T, 4H] pre-projected; Weight [P, 4H] recurrent over the
+    PROJECTION r; ProjWeight [H, P]. r_t = proj_act(h_t @ ProjWeight).
+    Gate order (c_hat, i, f, o) as lstm above. Returns Projection [B,T,P]
+    and Cell [B,T,H]."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    pw = ctx.input("ProjWeight")
+    b = ctx.input("Bias")
+    H, P = pw.shape
+    B = x.shape[0]
+    cand_act = _act(ctx.attr("candidate_activation", "tanh"))
+    gate_act = _act(ctx.attr("gate_activation", "sigmoid"))
+    cell_act = _act(ctx.attr("cell_activation", "tanh"))
+    proj_act_name = ctx.attr("proj_activation", "identity")
+    proj_act = (lambda v: v) if proj_act_name == "identity" \
+        else _act(proj_act_name)
+    reverse = bool(ctx.attr("is_reverse", False))
+    r0 = jnp.zeros((B, P), x.dtype)
+    c0 = jnp.zeros((B, H), x.dtype)
+
+    def step(carry, x_t):
+        r, c = carry
+        g = x_t + r @ w
+        if b is not None:
+            g = g + b.reshape(1, -1)[:, :4 * H]
+        c_hat = cand_act(g[:, :H])
+        i = gate_act(g[:, H: 2 * H])
+        f = gate_act(g[:, 2 * H: 3 * H])
+        o = gate_act(g[:, 3 * H:])
+        c2 = f * c + i * c_hat
+        h2 = o * cell_act(c2)
+        r2 = proj_act(h2 @ pw)
+        return (r2, c2), (r2, c2)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0), xs, reverse=reverse)
+    return {"Projection": jnp.swapaxes(rs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("cudnn_lstm", needs_rng=True)
+def cudnn_lstm(ctx: ExecContext):
+    """Stacked (optionally bidirectional) LSTM (reference cudnn_lstm_op.cc /
+    layers.lstm). Input [B, T, D]; the flat W packs per layer+direction:
+    Wx [in, 4H], Wh [H, 4H], bias [4H] (gate order i, f, c, o — the cudnn
+    convention, which differs from lstm_op's). InitH/InitC
+    [L*dirs, B, H]. Inter-layer dropout (cudnn semantics: between stacked
+    layers, never after the last) applies when dropout_prob > 0 and not
+    is_test. Returns Out [B, T, H*dirs], LastH, LastC."""
+    x = ctx.input("Input")
+    flat = ctx.input("W").reshape(-1)
+    init_h = ctx.input("InitH")
+    init_c = ctx.input("InitC")
+    L = int(ctx.attr("num_layers", 1))
+    H = int(ctx.attr("hidden_size"))
+    bidi = bool(ctx.attr("is_bidirec", False))
+    dirs = 2 if bidi else 1
+    B, T, D = x.shape
+
+    def one_dir(inp, wx, wh, bias, h0, c0, reverse):
+        def step(carry, x_t):
+            h, c = carry
+            g = x_t @ wx + h @ wh + bias
+            i = jax.nn.sigmoid(g[:, :H])
+            f = jax.nn.sigmoid(g[:, H:2 * H])
+            c_hat = jnp.tanh(g[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(g[:, 3 * H:])
+            c2 = f * c + i * c_hat
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        xs = jnp.swapaxes(inp, 0, 1)
+        (hT, cT), hs = jax.lax.scan(step, (h0, c0), xs, reverse=reverse)
+        return jnp.swapaxes(hs, 0, 1), hT, cT
+
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        v = flat[off:off + n].reshape(shape)
+        off += n
+        return v
+
+    dropout = float(ctx.attr("dropout_prob", 0.0))
+    train_dropout = dropout > 0.0 and not bool(ctx.attr("is_test", False))
+    key = ctx.rng
+    out = x
+    last_h, last_c = [], []
+    for layer in range(L):
+        if layer > 0 and train_dropout:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - dropout, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0)
+        in_dim = D if layer == 0 else H * dirs
+        outs = []
+        for d in range(dirs):
+            wx = take(in_dim * 4 * H, (in_dim, 4 * H))
+            wh = take(H * 4 * H, (H, 4 * H))
+            bias = take(4 * H, (4 * H,))
+            idx = layer * dirs + d
+            o, hT, cT = one_dir(out, wx, wh, bias, init_h[idx], init_c[idx],
+                                reverse=(d == 1))
+            outs.append(o)
+            last_h.append(hT)
+            last_c.append(cT)
+        out = jnp.concatenate(outs, axis=-1) if dirs == 2 else outs[0]
+    return {"Out": out, "LastH": jnp.stack(last_h),
+            "LastC": jnp.stack(last_c)}
+
+
+@register_op("row_conv")
+def row_conv(ctx: ExecContext):
+    """Lookahead row convolution (reference row_conv_op.cc): X [B, T, D],
+    Filter [k+1, D]; out[t] = sum_{i=0..k} x[t+i] * filter[i] elementwise
+    per feature (future context only, zero past the end)."""
+    x = ctx.input("X")
+    filt = ctx.input("Filter")
+    k1 = filt.shape[0]
+    B, T, D = x.shape
+    t = jnp.arange(T, dtype=jnp.int32)
+    out = jnp.zeros_like(x)
+    for i in range(k1):
+        src = t + i
+        ok = src < T
+        g = x[:, jnp.clip(src, 0, T - 1), :]
+        out = out + jnp.where(ok[None, :, None], g, 0.0) * filt[i][None, None, :]
+    return {"Out": out}

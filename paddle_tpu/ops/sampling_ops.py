@@ -165,3 +165,98 @@ def hierarchical_sigmoid(ctx: ExecContext):
     loss = jnp.where(valid, per_node, 0.0).sum(axis=1)
     return {"Out": loss[:, None].astype(x.dtype),
             "PreOut": pre.astype(x.dtype)}
+
+
+@register_op("gaussian_random_batch_size_like", grad="none", needs_rng=True)
+def gaussian_random_batch_size_like(ctx: ExecContext):
+    """reference gaussian_random_batch_size_like_op.cc: normal(mean, std)
+    with the batch dim taken from Input."""
+    from ..core.types import np_dtype
+
+    x = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[int(ctx.attr("output_dim_idx", 0))] = \
+        x.shape[int(ctx.attr("input_dim_idx", 0))]
+    mean = float(ctx.attr("mean", 0.0))
+    std = float(ctx.attr("std", 1.0))
+    dt = np_dtype(ctx.attr("dtype", "float32"))
+    return {"Out": mean + std * jax.random.normal(
+        ctx.rng, tuple(int(s) for s in shape), dt)}
+
+
+def _log_uniform_prob(ids, range_max):
+    """LogUniformSampler class probability (reference math/sampler.cc):
+    p(c) = log((c+2)/(c+1)) / log(range_max+1)."""
+    c = ids.astype(jnp.float32)
+    return jnp.log((c + 2.0) / (c + 1.0)) / jnp.log(float(range_max) + 1.0)
+
+
+def _sample_logits_grad_maker(op, block, no_grad_set=frozenset()):
+    """Custom maker: the backward scatter needs the forward's Samples
+    OUTPUT (which the default mirror-slots maker never passes)."""
+    from ..framework import grad_var_name
+
+    lname = op.inputs["Logits"][0]
+    if lname in no_grad_set:
+        return []
+    return [{
+        "type": "sample_logits_grad",
+        "inputs": {
+            "Logits": list(op.inputs["Logits"]),
+            "Samples": list(op.outputs["Samples"]),
+            "SampledLogits@GRAD":
+                [grad_var_name(op.outputs["SampledLogits"][0])],
+        },
+        "outputs": {"Logits@GRAD": [grad_var_name(lname)]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+@register_op("sample_logits", needs_rng=True, grad=_sample_logits_grad_maker)
+def sample_logits(ctx: ExecContext):
+    """reference sample_logits_op.*: subsample the softmax vocabulary.
+    Logits [B, V], Labels [B, NT] -> Samples [B, NT+S] (true labels first,
+    then S log-uniform draws), SampledLogits [B, NT+S] with each logit
+    adjusted by -log(expected_prob) (the sampled-softmax correction), and
+    SampledLabel [B, NT] = arange(NT). remove_accidental_hits pushes
+    negatives that collide with a true label to -inf. Sampling is
+    with-replacement log-uniform (the reference's unique-draw retry loop is
+    a host pattern; collisions are rare at CTR/NLP vocab sizes)."""
+    logits = ctx.input("Logits")
+    labels = ctx.input("Labels").astype(jnp.int32)
+    if labels.ndim == 1:
+        labels = labels[:, None]
+    B, V = logits.shape
+    NT = labels.shape[1]
+    S = int(ctx.attr("num_samples"))
+    u = jax.random.uniform(ctx.rng, (B, S), jnp.float32, 1e-9, 1.0)
+    draws = (jnp.exp(u * jnp.log(float(V) + 1.0)) - 1.0).astype(jnp.int32)
+    draws = jnp.clip(draws, 0, V - 1)
+    samples = jnp.concatenate([labels, draws], axis=1)      # [B, NT+S]
+    q = _log_uniform_prob(samples, V)
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    adjusted = picked - jnp.log(q + 1e-20)
+    if bool(ctx.attr("remove_accidental_hits", True)):
+        hit = (draws[:, :, None] == labels[:, None, :]).any(-1)  # [B, S]
+        pad = jnp.concatenate(
+            [jnp.zeros((B, NT), bool), hit], axis=1)
+        adjusted = jnp.where(pad, adjusted - 1e20, adjusted)
+    return {"Samples": samples.astype(jnp.int64),
+            "SampledLogits": adjusted.astype(logits.dtype),
+            "SampledLabel": jnp.broadcast_to(
+                jnp.arange(NT, dtype=jnp.int64)[None, :], (B, NT)),
+            "Probabilities": q.astype(logits.dtype)}
+
+
+@register_grad_compute("sample_logits")
+def sample_logits_grad(ctx: ExecContext):
+    """dLogits = scatter of dSampledLogits back to the sampled columns."""
+    logits = ctx.input("Logits")
+    samples = ctx.input("Samples").astype(jnp.int32)
+    g = ctx.input("SampledLogits@GRAD")
+    if g is None:
+        return {"Logits@GRAD": jnp.zeros_like(logits)}
+    B = logits.shape[0]
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], samples.shape)
+    return {"Logits@GRAD": jnp.zeros_like(logits).at[bidx, samples].add(
+        g.astype(logits.dtype))}

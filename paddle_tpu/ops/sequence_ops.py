@@ -290,9 +290,12 @@ def sequence_erase(ctx: ExecContext):
     Out [B, T] zero-padded + OutLength. The data-dependent compaction is a
     cumsum-scatter (static shapes)."""
     x = ctx.input("X")
-    ln = ctx.input("Length").reshape(-1).astype(jnp.int32)
-    tokens = [int(t) for t in ctx.attr("tokens", [])]
     B, T = x.shape
+    if ctx.has_input("Length"):
+        ln = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    tokens = [int(t) for t in ctx.attr("tokens", [])]
     t = jnp.arange(T, dtype=jnp.int32)[None, :]
     valid = t < ln[:, None]
     keep = valid
@@ -347,3 +350,132 @@ def sequence_scatter(ctx: ExecContext):
         upd = jnp.where(m, upd, jnp.zeros_like(upd))
     b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, S))
     return {"Out": x.at[b_idx, ids].add(upd)}
+
+
+@register_op("sequence_conv")
+def sequence_conv(ctx: ExecContext):
+    """reference sequence_ops/sequence_conv_op.*: context-window conv over
+    time. X [B, T, D] + Filter [contextLength*D, F] -> Out [B, T, F]: at each
+    step t the rows X[t+start : t+start+len] concatenate (zeros outside the
+    valid region — the reference's up/down zero padding) and multiply the
+    filter. Length [B] masks trailing padding rows."""
+    x = ctx.input("X")
+    filt = ctx.input("Filter")
+    start = int(ctx.attr("contextStart", -1))
+    length = int(ctx.attr("contextLength", 3))
+    stride = int(ctx.attr("contextStride", 1))
+    if stride != 1:
+        raise NotImplementedError("sequence_conv: contextStride must be 1 "
+                                  "(reference enforces the same)")
+    B, T, D = x.shape
+    t = jnp.arange(T, dtype=jnp.int32)
+    cols = []
+    for j in range(length):
+        src = t + start + j                       # window tap j per step
+        valid = (src >= 0) & (src < T)
+        g = x[:, jnp.clip(src, 0, T - 1), :]
+        cols.append(jnp.where(valid[None, :, None], g, 0.0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)      # [B, T, len*D]
+    out = jnp.einsum("btk,kf->btf", ctx_mat, filt)
+    if ctx.has_input("Length"):
+        ln = ctx.input("Length").reshape(-1).astype(jnp.int32)
+        out = jnp.where((t[None, :] < ln[:, None])[:, :, None], out, 0.0)
+    return {"Out": out}
+
+
+@register_op("sequence_enumerate", grad="none")
+def sequence_enumerate(ctx: ExecContext):
+    """reference sequence_ops/sequence_enumerate_op.*: sliding id windows.
+    X [B, T] int -> Out [B, T, win_size]; window positions past the valid
+    length (or past T) fill with pad_value."""
+    x = ctx.input("X")
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    win = int(ctx.attr("win_size", 2))
+    pad = int(ctx.attr("pad_value", 0))
+    B, T = x.shape
+    t = jnp.arange(T, dtype=jnp.int32)
+    if ctx.has_input("Length"):
+        ln = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    else:
+        ln = jnp.full((B,), T, jnp.int32)
+    outs = []
+    for j in range(win):
+        src = t + j
+        ok = src[None, :] < ln[:, None]
+        g = x[:, jnp.clip(src, 0, T - 1)]
+        outs.append(jnp.where(ok, g, jnp.asarray(pad, x.dtype)))
+    return {"Out": jnp.stack(outs, axis=-1)}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx: ExecContext):
+    """reference sequence_ops/sequence_reshape_op.*: re-chunk the time x dim
+    product to a new row width. [B, T, D] -> [B, T*D/new_dim, new_dim]."""
+    x = ctx.input("X")
+    new_dim = int(ctx.attr("new_dim", 1))
+    B = x.shape[0]
+    total = 1
+    for d in x.shape[1:]:
+        total *= d
+    if total % new_dim:
+        raise ValueError(
+            f"sequence_reshape: {total} values per row not divisible by "
+            f"new_dim {new_dim}")
+    return {"Out": x.reshape(B, total // new_dim, new_dim)}
+
+
+@register_op("sequence_topk_avg_pooling")
+def sequence_topk_avg_pooling(ctx: ExecContext):
+    """reference sequence_ops/sequence_topk_avg_pooling_op.h: per channel and
+    per row of a [B, C, R, W] score tensor, average the top-k column scores
+    for each k in `topks`. Out [B, R, C*len(topks)] matches the reference's
+    row-major (r, channel, k) layout; ColLength [B] masks invalid columns
+    (fewer valid than k -> average of all valid over k, like the reference's
+    -1-position carry)."""
+    x = ctx.input("X")
+    topks = [int(k) for k in ctx.attr("topks", [1])]
+    B, C, R, W = x.shape
+    if ctx.has_input("ColLength"):
+        cl = ctx.input("ColLength").reshape(-1).astype(jnp.int32)
+    else:
+        cl = jnp.full((B,), W, jnp.int32)
+    col_ok = jnp.arange(W, dtype=jnp.int32)[None, :] < cl[:, None]  # [B, W]
+    neg = jnp.finfo(x.dtype).min
+    masked = jnp.where(col_ok[:, None, None, :], x, neg)
+    s = jnp.sort(masked, axis=-1)[..., ::-1]                # desc [B,C,R,W]
+    rank_ok = jnp.arange(W, dtype=jnp.int32)[None, None, None, :] < \
+        cl[:, None, None, None]
+    s = jnp.where(rank_ok, s, 0.0)                          # invalid -> 0
+    csum = jnp.cumsum(s, axis=-1)
+    pooled = []
+    for k in topks:
+        idx = min(k, W) - 1
+        pooled.append(csum[..., idx] / float(k))            # [B, C, R]
+    out = jnp.stack(pooled, axis=-1)                        # [B, C, R, K]
+    out = out.transpose(0, 2, 1, 3).reshape(B, R, C * len(topks))
+    if ctx.has_input("RowLength"):
+        rl = ctx.input("RowLength").reshape(-1).astype(jnp.int32)
+        row_ok = jnp.arange(R, dtype=jnp.int32)[None, :] < rl[:, None]
+        out = jnp.where(row_ok[:, :, None], out, 0.0)
+    return {"Out": out}
+
+
+@register_op("match_matrix_tensor")
+def match_matrix_tensor(ctx: ExecContext):
+    """reference match_matrix_tensor_op.*: semantic match of two sequences.
+    X [B, Tx, H], Y [B, Ty, H], W [H, C, H] -> Out [B, C, Tx, Ty] where
+    Out[b,c,i,j] = x_i^T W_c y_j (the reference's per-pair [n, C, m] blocks,
+    batched on the padding contract); XLength/YLength zero the padded tail."""
+    x, y, w = ctx.input("X"), ctx.input("Y"), ctx.input("W")
+    out = jnp.einsum("bih,hcg,bjg->bcij", x, w, y)
+    Tx, Ty = x.shape[1], y.shape[1]
+    if ctx.has_input("XLength"):
+        xl = ctx.input("XLength").reshape(-1).astype(jnp.int32)
+        m = jnp.arange(Tx, dtype=jnp.int32)[None, :] < xl[:, None]
+        out = jnp.where(m[:, None, :, None], out, 0.0)
+    if ctx.has_input("YLength"):
+        yl = ctx.input("YLength").reshape(-1).astype(jnp.int32)
+        m = jnp.arange(Ty, dtype=jnp.int32)[None, :] < yl[:, None]
+        out = jnp.where(m[:, None, None, :], out, 0.0)
+    return {"Out": out}

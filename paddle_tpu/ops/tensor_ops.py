@@ -437,3 +437,246 @@ def scatter_nd(ctx: ExecContext):
     shape = [int(s) for s in ctx.attr("shape")]
     z = jnp.zeros(shape, upd.dtype)
     return {"Out": z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}
+
+
+@register_op("hash", grad="none")
+def hash_op(ctx: ExecContext):
+    """reference hash_op.* (xxHash of the id bytes mod mod_by): num_hash
+    independent hashes of each input row's int ids. The TPU redesign uses a
+    splitmix64-style integer mix (hashing only needs dispersion, not the
+    exact xxhash bit pattern) — one fused integer pipeline, no host trip."""
+    x = ctx.input("X")
+    num_hash = int(ctx.attr("num_hash", 1))
+    mod_by = int(ctx.attr("mod_by", 100000))
+    v = x.astype(jnp.uint32)
+    outs = []
+    for seed in range(num_hash):
+        h = v ^ jnp.uint32((0x9E3779B9 * (seed + 1)) & 0xFFFFFFFF)
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        # fold the last-dim id vector into ONE bucket per row (the
+        # reference hashes the whole row's bytes)
+        row = jnp.zeros(h.shape[:-1], jnp.uint32)
+        for j in range(x.shape[-1]):
+            row = row * jnp.uint32(31) + h[..., j]
+        outs.append((row % jnp.uint32(mod_by)).astype(jnp.int64))
+    return {"Out": jnp.stack(outs, axis=-1)[..., None]}  # [.., num_hash, 1]
+
+
+def cvm(ctx: ExecContext):
+    """reference cvm_op.h: continuous-value-model feature transform. X
+    [B, D] with the first two columns (show, click); use_cvm=True keeps
+    width and rewrites col0=log(show+1), col1=log(click+1)-log(show+1);
+    False strips both columns."""
+    x = ctx.input("X")
+    if bool(ctx.attr("use_cvm", True)):
+        c0 = jnp.log(x[:, :1] + 1.0)
+        c1 = jnp.log(x[:, 1:2] + 1.0) - c0
+        return {"Y": jnp.concatenate([c0, c1, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+def _cvm_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    xname = op.inputs["X"][0]
+    if xname in no_grad_set:
+        return []
+    return [{
+        "type": "cvm_grad",
+        "inputs": {"X": list(op.inputs["X"]),
+                   "CVM": list(op.inputs.get("CVM", [])),
+                   "Y@GRAD": [grad_var_name(op.outputs["Y"][0])]},
+        "outputs": {"X@GRAD": [grad_var_name(xname)]},
+        "attrs": dict(op.attrs),
+    }]
+
+
+register_op("cvm", grad=_cvm_grad_maker)(cvm)
+
+
+@register_grad_compute("cvm")
+def cvm_grad(ctx: ExecContext):
+    """reference CvmGradComputeKernel: pass-through for the non-cvm columns;
+    the two cvm columns take the raw CVM feature values (not a chain-rule
+    term — the reference's deliberate straight-through)."""
+    x = ctx.input("X")
+    gy = ctx.input("Y@GRAD")
+    cvm_in = ctx.input("CVM")
+    B = x.shape[0]
+    if cvm_in is None:
+        cvm_in = jnp.zeros((B, 2), x.dtype)
+    if bool(ctx.attr("use_cvm", True)):
+        body = gy[:, 2:]
+    else:
+        body = gy
+    return {"X@GRAD": jnp.concatenate(
+        [cvm_in[:, :2].astype(x.dtype), body], axis=1)}
+
+
+def _unique_ordered(ctx):
+    """First-occurrence-order dedup (np.unique sorts; the reference keeps
+    encounter order). Index dtype follows the op's dtype attr."""
+    import numpy as np
+
+    x = np.asarray(ctx.input("X")).reshape(-1)
+    first = np.sort(np.unique(x, return_index=True)[1])
+    ordered = x[first]
+    remap = {v: i for i, v in enumerate(ordered.tolist())}
+    idx_dt = np.int64 if str(ctx.attr("dtype", "int32")).endswith("64") \
+        else np.int32
+    index = np.asarray([remap[v] for v in x.tolist()], idx_dt)
+    return ordered, index
+
+
+@register_op("unique", grad="none", host=True)
+def unique(ctx: ExecContext):
+    """reference unique_op.*: dynamic-shaped dedup. Host op — the output
+    extent is data-dependent, which XLA cannot express; unique feeds host
+    paths (sparse-id preprocessing) in practice."""
+    ordered, index = _unique_ordered(ctx)
+    return {"Out": ordered, "Index": index}
+
+
+@register_op("unique_with_counts", grad="none", host=True)
+def unique_with_counts(ctx: ExecContext):
+    import numpy as np
+
+    ordered, index = _unique_ordered(ctx)
+    counts = np.bincount(index, minlength=len(ordered)).astype(np.int64)
+    return {"Out": ordered, "Index": index, "Count": counts}
+
+
+@register_op("merge_selected_rows", grad="none", host=True)
+def merge_selected_rows(ctx: ExecContext):
+    """reference merge_selected_rows_op.cc: sum duplicate rows of a
+    SelectedRows. Host op (SelectedRows live on the host side of the
+    executor; their dense payloads are device arrays)."""
+    import numpy as np
+
+    from ..core.selected_rows import SelectedRows
+
+    sr = ctx.input("X")
+    rows = np.asarray(sr.rows)
+    vals = np.asarray(sr.values)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    merged = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+    np.add.at(merged, inv, vals)
+    return {"Out": SelectedRows(uniq, merged, sr.height)}
+
+
+@register_op("get_tensor_from_selected_rows", grad="none", host=True)
+def get_tensor_from_selected_rows(ctx: ExecContext):
+    """reference get_tensor_from_selected_rows_op.cc: expose the value
+    tensor of a SelectedRows."""
+    import numpy as np
+
+    sr = ctx.input("X")
+    return {"Out": np.asarray(sr.values)}
+
+
+@register_op("filter_by_instag", grad="none", host=True)
+def filter_by_instag(ctx: ExecContext):
+    """reference filter_by_instag_op.*: keep rows whose tag set intersects
+    the filter tags. Host op (data-dependent output extent). Ins [B, D],
+    Ins_tag [B, T] (padded with -1), Filter_tag [K] -> Out (kept rows),
+    LossWeight [kept, 1], IndexMap [kept, 2] (out row -> in row)."""
+    import numpy as np
+
+    ins = np.asarray(ctx.input("Ins"))
+    tags = np.asarray(ctx.input("Ins_tag"))
+    filt = set(np.asarray(ctx.input("Filter_tag")).reshape(-1).tolist())
+    keep = [b for b in range(ins.shape[0])
+            if filt & set(tags[b].reshape(-1).tolist())]
+    if not keep:
+        out = np.zeros((1,) + ins.shape[1:], ins.dtype)
+        return {"Out": out,
+                "LossWeight": np.zeros((1, 1), np.float32),
+                "IndexMap": np.zeros((1, 2), np.int64)}
+    keep = np.asarray(keep, np.int64)
+    return {"Out": ins[keep],
+            "LossWeight": np.ones((len(keep), 1), np.float32),
+            "IndexMap": np.stack([np.arange(len(keep)), keep], axis=1)}
+
+
+# --------------------------------------------------------------------------
+# py_func: the user-extensibility escape hatch (reference py_func_op.cc).
+# Callables register process-locally by integer id; the op is a HOST op, so
+# the executor splits the jit around it and hands it real arrays.
+# --------------------------------------------------------------------------
+
+PY_FUNC_REGISTRY: list = []
+
+
+def register_py_func(fn) -> int:
+    PY_FUNC_REGISTRY.append(fn)
+    return len(PY_FUNC_REGISTRY) - 1
+
+
+def py_func(ctx: ExecContext):
+    """reference py_func_op.cc: call a registered Python callable on the
+    input arrays; outputs map positionally onto the Out slot."""
+    import numpy as np
+
+    fn = PY_FUNC_REGISTRY[int(ctx.attr("forward_callable_id"))]
+    args = [None if v is None else np.asarray(v) for v in ctx.inputs("X")]
+    res = fn(*args)
+    if res is None:
+        res = ()
+    if not isinstance(res, (list, tuple)):
+        res = (res,)
+    outs = list(ctx.op.outputs.get("Out", []))
+    if len(res) != len(outs):
+        raise ValueError(
+            f"py_func returned {len(res)} values for {len(outs)} output "
+            f"variables")
+    return {"Out": [np.asarray(r) for r in res]}
+
+
+def _py_func_grad_maker(op, block, no_grad_set=frozenset()):
+    from ..framework import grad_var_name
+
+    if int(op.attrs.get("backward_callable_id", -1)) < 0:
+        return []
+    gouts = []
+    for n in op.inputs.get("X", []):
+        gouts.append("" if n in no_grad_set else grad_var_name(n))
+    if not any(gouts):
+        return []
+    return [{
+        "type": "py_func_grad",
+        "inputs": {
+            "X": list(op.inputs["X"]),
+            "Out": list(op.outputs["Out"]),
+            "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]],
+        },
+        "outputs": {"X@GRAD": gouts},
+        "attrs": dict(op.attrs),
+    }]
+
+
+register_op("py_func", host=True, grad=_py_func_grad_maker)(py_func)
+
+
+@register_op("py_func_grad", host=True, no_grad=True)
+def py_func_grad(ctx: ExecContext):
+    """Backward escape hatch: backward_func(*(X + Out + Out@GRAD), minus the
+    names listed in skip_vars_in_backward_input) -> grads aligned with X."""
+    import numpy as np
+
+    fn = PY_FUNC_REGISTRY[int(ctx.attr("backward_callable_id"))]
+    skip = set(ctx.attr("skip_names", []) or [])
+    args = []
+    for slot in ("X", "Out", "Out@GRAD"):
+        for n, v in zip(ctx.op.inputs.get(slot, []), ctx.inputs(slot)):
+            if n in skip:
+                continue
+            args.append(None if v is None else np.asarray(v))
+    res = fn(*args)
+    if not isinstance(res, (list, tuple)):
+        res = (res,)
+    width = len(ctx.op.outputs.get("X@GRAD", []))
+    res = list(res) + [None] * (width - len(res))
+    return {"X@GRAD": [None if r is None else np.asarray(r)
+                       for r in res[:width]]}

@@ -345,3 +345,222 @@ def sampling_id(ctx: ExecContext):
     p = ctx.input("X")
     return {"Out": jax.random.categorical(
         ctx.rng, jnp.log(jnp.maximum(p, 1e-20)), axis=-1).astype(jnp.int64)}
+
+
+@register_op("trilinear_interp")
+def trilinear_interp(ctx: ExecContext):
+    """reference interpolate_op.* trilinear path on [N, C, D, H, W].
+    Separable per-axis linear interpolation, so all three coordinate
+    conventions share _src_coords with the 2-D ops."""
+    x = ctx.input("X")
+    out_d = int(ctx.attr("out_d", 0))
+    out_h = int(ctx.attr("out_h", 0))
+    out_w = int(ctx.attr("out_w", 0))
+    scale = float(ctx.attr("scale", 0.0) or 0.0)
+    if out_d <= 0 or out_h <= 0 or out_w <= 0:
+        if scale <= 0:
+            raise ValueError("trilinear resize needs out_d/h/w or scale")
+        out_d = int(x.shape[2] * scale)
+        out_h = int(x.shape[3] * scale)
+        out_w = int(x.shape[4] * scale)
+    align_corners = bool(ctx.attr("align_corners", False))
+    align_mode = int(ctx.attr("align_mode", 1))
+    out = x.astype(jnp.float32)
+    for axis, out_len in ((2, out_d), (3, out_h), (4, out_w)):
+        in_len = out.shape[axis]
+        s = _src_coords(out_len, in_len, align_corners, align_mode)
+        i0 = jnp.clip(jnp.floor(s).astype(jnp.int32), 0, in_len - 1)
+        i1 = jnp.minimum(i0 + 1, in_len - 1)
+        w = (s - i0).reshape((1,) * axis + (-1,) +
+                             (1,) * (out.ndim - axis - 1))
+        out = jnp.take(out, i0, axis=axis) * (1 - w) + \
+            jnp.take(out, i1, axis=axis) * w
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx: ExecContext):
+    """reference conv_transpose_op.* 3-D path (NCDHW, filter C_in-major like
+    conv2d_transpose above)."""
+    x, w = ctx.input("Input"), ctx.input("Filter")
+
+    def trip(v):
+        v = list(v) if isinstance(v, (list, tuple)) else [v] * 3
+        return v if len(v) == 3 else v * 3
+
+    strides = trip(ctx.attr("strides", [1, 1, 1]))
+    p = trip(ctx.attr("paddings", [0, 0, 0]))
+    d = trip(ctx.attr("dilations", [1, 1, 1]))
+    # explicit padding applies to the dilated input (see conv2d_transpose):
+    # each side pads d*(k-1) - p for the reference output extent
+    ke = [d[i] * (w.shape[2 + i] - 1) for i in range(3)]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(ke[i] - p[i], ke[i] - p[i]) for i in range(3)],
+        rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True,
+    ).astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("adaptive_pool3d")
+def adaptive_pool3d(ctx: ExecContext):
+    """reference pool_op adaptive 3-D: even-bin partition (static shapes)."""
+    x = ctx.input("X")
+    od, oh, ow = [int(v) for v in ctx.attr("pooled_size")]
+    ptype = ctx.attr("pooling_type", "avg")
+    N, C, D, H, W = x.shape
+    if D % od or H % oh or W % ow:
+        raise ValueError(
+            f"adaptive_pool3d: input {D}x{H}x{W} not divisible by output "
+            f"{od}x{oh}x{ow}")
+    r = x.reshape(N, C, od, D // od, oh, H // oh, ow, W // ow)
+    out = r.max(axis=(3, 5, 7)) if ptype == "max" else r.mean(axis=(3, 5, 7))
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("affine_grid")
+def affine_grid(ctx: ExecContext):
+    """reference affine_grid_op.*: Theta [N, 2, 3] -> sampling grid
+    [N, H, W, 2] over the align_corners=True normalized [-1, 1] mesh (the
+    reference's Linspace semantics)."""
+    theta = ctx.input("Theta")
+    shape = [int(v) for v in ctx.attr("output_shape")]
+    H, W = shape[2], shape[3]
+    ys = jnp.linspace(-1.0, 1.0, H, dtype=jnp.float32)
+    xs = jnp.linspace(-1.0, 1.0, W, dtype=jnp.float32)
+    gx, gy = jnp.meshgrid(xs, ys)                      # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)          # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base, theta.astype(jnp.float32))
+    return {"Output": out.astype(theta.dtype)}
+
+
+@register_op("im2sequence", grad="none")
+def im2sequence(ctx: ExecContext):
+    """reference im2sequence_op.*: sliding-window im2col. X [B, C, H, W] ->
+    Out [B, n_windows, C*kh*kw] (the reference emits the LoD-flattened
+    [B*n, C*kh*kw]; the padded design keeps the batch axis)."""
+    x = ctx.input("X")
+    kh, kw = [int(v) for v in ctx.attr("kernels")]
+    sh, sw = [int(v) for v in ctx.attr("strides", [1, 1])]
+    pads = [int(v) for v in ctx.attr("paddings", [0, 0, 0, 0])]
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    B, C, H, W = x.shape
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))    # [B, C*kh*kw, oh, ow]
+    out = patches.reshape(B, C * kh * kw, oh * ow).transpose(0, 2, 1)
+    return {"Out": out}
+
+
+@register_op("random_crop", needs_rng=True, grad="none")
+def random_crop(ctx: ExecContext):
+    """reference random_crop_op.*: per-sample random spatial crop to `shape`
+    (trailing dims). Offsets draw from the op's RNG key."""
+    x = ctx.input("X")
+    shape = [int(v) for v in ctx.attr("shape")]
+    n_crop = len(shape)
+    B = x.shape[0]
+    key = ctx.rng
+    outs_axes = []
+    for j, tgt in enumerate(shape):
+        axis = x.ndim - n_crop + j
+        extent = x.shape[axis]
+        if tgt > extent:
+            raise ValueError(f"random_crop: target {tgt} > extent {extent}")
+        key, sub = jax.random.split(key)
+        outs_axes.append(jax.random.randint(sub, (B,), 0, extent - tgt + 1))
+
+    def crop_one(xb, starts):
+        out = xb
+        for j, (t, s) in enumerate(zip(shape, starts)):
+            axis = xb.ndim - n_crop + j
+            out = jax.lax.dynamic_slice_in_dim(out, s, t, axis=axis)
+        return out
+
+    starts = jnp.stack(outs_axes, axis=1)              # [B, n_crop]
+    out = jax.vmap(crop_one)(x, starts)
+    return {"Out": out}
+
+
+@register_op("deformable_conv")
+def deformable_conv(ctx: ExecContext):
+    """reference deformable_conv_op.* (v2, modulated): each kernel tap of a
+    standard conv samples the input at p + learned offset, scaled by a
+    learned mask, via bilinear interpolation. X [B, Cin, H, W]; Offset
+    [B, 2*dg*kh*kw, OH, OW] (y,x interleaved per tap); Mask
+    [B, dg*kh*kw, OH, OW]; Filter [Cout, Cin/groups, kh, kw].
+    deformable_groups splits channels over offset groups."""
+    x = ctx.input("Input")
+    offset = ctx.input("Offset")
+    mask = ctx.input("Mask")
+    w = ctx.input("Filter")
+    sh, sw = _pair2(ctx.attr("strides", [1, 1]))
+    ph, pw_ = _pair2(ctx.attr("paddings", [0, 0]))
+    dh, dw = _pair2(ctx.attr("dilations", [1, 1]))
+    groups = int(ctx.attr("groups", 1))
+    dg = int(ctx.attr("deformable_groups", 1))
+    B, Cin, H, W = x.shape
+    Cout, _, kh, kw = w.shape
+    OH = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    OW = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+    f = x.astype(jnp.float32)
+
+    oy = jnp.arange(OH) * sh - ph
+    ox = jnp.arange(OW) * sw - pw_
+    cols = []
+    for ky in range(kh):
+        for kx in range(kw):
+            tap = ky * kw + kx
+            off_y = offset[:, 2 * tap::2 * kh * kw]    # [B, dg, OH, OW]
+            off_x = offset[:, 2 * tap + 1::2 * kh * kw]
+            m = mask[:, tap::kh * kw] if mask is not None else None
+            py = oy[None, None, :, None] + ky * dh + off_y
+            px = ox[None, None, None, :] + kx * dw + off_x
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+            vals = 0.0
+            for (yy, wyy) in ((y0, 1 - wy), (y0 + 1, wy)):
+                for (xx, wxx) in ((x0, 1 - wx), (x0 + 1, wx)):
+                    ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                    yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                    xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                    # gather per offset-group, then broadcast to its channels
+                    def g(c_grp, yi=yi, xi=xi):
+                        # c_grp: [B, Cg, H, W] -> sample at [B, dg, OH, OW]
+                        bidx = jnp.arange(B)[:, None, None, None]
+                        didx = jnp.arange(dg)[None, :, None, None]
+                        return c_grp.reshape(B, dg, Cin // dg, H, W)[
+                            bidx, didx, :, yi, xi]     # [B,dg,OH,OW,Cg]
+                    sampled = g(f)                      # [B,dg,OH,OW,Cin/dg]
+                    vals = vals + (ok * wyy * wxx)[..., None] * \
+                        jnp.where(ok[..., None], sampled, 0.0)
+            if m is not None:
+                vals = vals * m[..., None]
+            cols.append(vals.transpose(0, 1, 4, 2, 3).reshape(
+                B, Cin, OH, OW))
+    # cols: kh*kw entries of [B, Cin, OH, OW] -> conv as 1x1 over taps
+    col = jnp.stack(cols, axis=2)                      # [B, Cin, kh*kw, OH, OW]
+    col = col.reshape(B, Cin * kh * kw, OH, OW)
+    wr = w.reshape(Cout, (Cin // groups) * kh * kw)
+    if groups == 1:
+        wk = w.transpose(1, 2, 3, 0).reshape(Cin * kh * kw, Cout)
+        out = jnp.einsum("bkhw,kc->bchw",
+                         col.reshape(B, Cin * kh * kw, OH, OW), wk)
+    else:
+        col_g = col.reshape(B, groups, (Cin // groups) * kh * kw, OH, OW)
+        wg = wr.reshape(groups, Cout // groups, -1)
+        out = jnp.einsum("bgkhw,gck->bgchw", col_g, wg).reshape(
+            B, Cout, OH, OW)
+    return {"Output": out.astype(x.dtype)}
+
+
+def _pair2(v):
+    v = list(v) if isinstance(v, (list, tuple)) else [v, v]
+    return v if len(v) == 2 else v * 2

@@ -1,0 +1,767 @@
+"""Round-4 layers-DSL tail: OpTest-grade numeric oracles for the new
+reference-nn.py parity batch (sequence_conv family, RNN variants, norms,
+losses, py_func escape hatch, misc tensor ops)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def _run(build, feeds, n_fetch=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=list(outs)[:n_fetch])
+        return [np.asarray(v) for v in vals]
+
+
+def _run_with_scope(build, feeds, fetch, scope):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            res = build()
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds,
+                       fetch_list=[res[i] for i in fetch])
+    return [np.asarray(v) for v in vals]
+
+
+# -- sequence family ---------------------------------------------------------
+
+def test_sequence_conv_matches_manual_window():
+    B, T, D, F, K = 2, 5, 3, 4, 3
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, T, D)).astype(np.float32)
+
+    def build():
+        v = L.data(name="x", shape=[T, D], dtype="float32")
+        return L.sequence_conv(v, num_filters=F, filter_size=K,
+                               bias_attr=False,
+                               param_attr=pt.ParamAttr(name="seqconv_w"))
+
+    scope = pt.Scope()
+    out, = _run_with_scope(lambda: [build()], {"x": x}, [0], scope)
+    w = np.asarray(scope.find_var("seqconv_w"))        # [K*D, F]
+    expect = np.zeros((B, T, F), np.float32)
+    for b in range(B):
+        for t in range(T):
+            ctx = []
+            for j in range(K):
+                s = t - K // 2 + j
+                ctx.append(x[b, s] if 0 <= s < T else np.zeros(D, np.float32))
+            expect[b, t] = np.concatenate(ctx) @ w
+    np.testing.assert_allclose(out, expect, rtol=2e-5, atol=1e-5)
+
+
+def test_sequence_enumerate_and_reshape():
+    x = np.array([[1, 2, 3, 4], [5, 6, 7, 0]], np.int64)
+    ln = np.array([4, 3], np.int64)
+
+    def build():
+        v = L.data(name="x", shape=[4], dtype="int64")
+        lv = L.data(name="ln", shape=[], dtype="int64")
+        en = L.sequence_enumerate(v, win_size=2, pad_value=0, length=lv)
+        r = L.data(name="r", shape=[2, 6], dtype="float32")
+        rs = L.sequence_reshape(r, new_dim=4)
+        return en, rs
+
+    en, rs = _run(lambda: list(build()),
+                  {"x": x, "ln": ln,
+                   "r": np.arange(24, dtype=np.float32).reshape(2, 2, 6)},
+                  n_fetch=2)
+    np.testing.assert_array_equal(
+        en[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+    np.testing.assert_array_equal(
+        en[1], [[5, 6], [6, 7], [7, 0], [0, 0]])
+    assert rs.shape == (2, 3, 4)
+    np.testing.assert_array_equal(rs[0, 0], [0, 1, 2, 3])
+
+
+def test_sequence_slice_scatter_expand_as():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+
+    def build():
+        v = L.data(name="x", shape=[4, 3], dtype="float32")
+        off = L.data(name="off", shape=[], dtype="int64")
+        ln = L.data(name="ln", shape=[], dtype="int64")
+        sl = L.sequence_slice(v, off, ln)
+        base = L.data(name="base", shape=[5], dtype="float32")
+        ids = L.data(name="ids", shape=[2], dtype="int64")
+        upd = L.data(name="upd", shape=[2], dtype="float32")
+        sc = L.sequence_scatter(base, ids, upd)
+        small = L.data(name="small", shape=[3], dtype="float32")
+        ex = L.sequence_expand_as(small, v)
+        return sl, sc, ex
+
+    sl, sc, ex = _run(
+        lambda: list(build()),
+        {"x": x, "off": np.array([1, 0], np.int64),
+         "ln": np.array([2, 3], np.int64),
+         "base": np.zeros((2, 5), np.float32),
+         "ids": np.array([[0, 2], [1, 1]], np.int64),
+         "upd": np.array([[1.0, 2.0], [3.0, 4.0]], np.float32),
+         "small": np.array([[1, 2, 3]], np.float32)},
+        n_fetch=3)
+    np.testing.assert_allclose(sl[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(sl[0, 2:], 0.0)
+    np.testing.assert_allclose(sc[0], [1, 0, 2, 0, 0])
+    np.testing.assert_allclose(sc[1], [0, 7, 0, 0, 0])  # 3+4 at idx 1
+    assert ex.shape == (2, 3)
+    np.testing.assert_allclose(ex, [[1, 2, 3], [1, 2, 3]])
+
+
+def test_sequence_topk_avg_pooling():
+    # B=1, C=2, R=2, W=4
+    x = np.array([[[[4.0, 1.0, 3.0, 2.0], [1.0, 1.0, 1.0, 1.0]],
+                   [[0.0, 10.0, 5.0, 1.0], [2.0, 4.0, 6.0, 8.0]]]],
+                 np.float32)
+
+    def build():
+        v = L.data(name="x", shape=[2, 2, 4], dtype="float32")
+        return L.sequence_topk_avg_pooling(v, topks=[1, 3], channel_num=2)
+
+    out, = _run(build, {"x": x})
+    assert out.shape == (1, 2, 4)  # [B, R, C*K]
+    # row 0: ch0 top1=4, top3 avg=(4+3+2)/3=3; ch1 top1=10, top3=(10+5+1)/3
+    np.testing.assert_allclose(out[0, 0], [4.0, 3.0, 10.0, 16.0 / 3],
+                               rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], [1.0, 1.0, 8.0, 6.0], rtol=1e-6)
+
+
+def test_match_matrix_tensor():
+    B, Tx, Ty, H, C = 2, 3, 4, 5, 2
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((B, Tx, H)).astype(np.float32)
+    y = rng.standard_normal((B, Ty, H)).astype(np.float32)
+
+    def build():
+        xv = L.data(name="x", shape=[Tx, H], dtype="float32")
+        yv = L.data(name="y", shape=[Ty, H], dtype="float32")
+        out, w = L.match_matrix_tensor(
+            xv, yv, channel_num=C, param_attr=pt.ParamAttr(name="mmt_w"))
+        return [out]
+
+    scope = pt.Scope()
+    out, = _run_with_scope(build, {"x": x, "y": y}, [0], scope)
+    w = np.asarray(scope.find_var("mmt_w"))
+    expect = np.einsum("bih,hcg,bjg->bcij", x, w, y)
+    np.testing.assert_allclose(out, expect, rtol=2e-5, atol=1e-5)
+
+
+# -- RNN variants ------------------------------------------------------------
+
+def test_lstm_cudnn_shapes_and_determinism():
+    B, T, D, H, NL = 2, 5, 4, 3, 2
+
+    def build():
+        v = L.data(name="x", shape=[T, D], dtype="float32")
+        h0 = L.data(name="h0", shape=[NL, B, H], dtype="float32",
+                    append_batch_size=False)
+        c0 = L.data(name="c0", shape=[NL, B, H], dtype="float32",
+                    append_batch_size=False)
+        out, lh, lc = L.lstm(v, h0, c0, max_len=T, hidden_size=H,
+                             num_layers=NL)
+        return [out, lh, lc]
+
+    rng = np.random.default_rng(2)
+    feeds = {"x": rng.standard_normal((B, T, D)).astype(np.float32),
+             "h0": np.zeros((NL, B, H), np.float32),
+             "c0": np.zeros((NL, B, H), np.float32)}
+    out, lh, lc = _run(lambda: build(), feeds, n_fetch=3)
+    assert out.shape == (B, T, H)
+    assert lh.shape == (NL, B, H) and lc.shape == (NL, B, H)
+    np.testing.assert_allclose(out[:, -1, :], lh[-1], rtol=1e-5)
+    assert np.abs(out).max() > 0
+
+
+def test_dynamic_lstmp_projection_path():
+    B, T, H, P = 2, 4, 3, 2
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((B, T, 4 * H)).astype(np.float32)
+
+    def build():
+        v = L.data(name="x", shape=[T, 4 * H], dtype="float32")
+        proj, cell = L.dynamic_lstmp(v, size=4 * H, proj_size=P,
+                                     use_peepholes=False)
+        return [proj, cell]
+
+    proj, cell = _run(lambda: build(), {"x": x}, n_fetch=2)
+    assert proj.shape == (B, T, P)
+    assert cell.shape == (B, T, H)
+    assert np.isfinite(proj).all()
+
+
+def test_lstm_unit_single_step_matches_formula():
+    B, D, H = 2, 3, 4
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    hp = rng.standard_normal((B, H)).astype(np.float32)
+    cp = rng.standard_normal((B, H)).astype(np.float32)
+
+    def build():
+        xv = L.data(name="x", shape=[D], dtype="float32")
+        hv = L.data(name="h", shape=[H], dtype="float32")
+        cv = L.data(name="c", shape=[H], dtype="float32")
+        h, c = L.lstm_unit(xv, hv, cv, forget_bias=1.0)
+        return [h, c]
+
+    h, c = _run(lambda: build(), {"x": x, "h": hp, "c": cp}, n_fetch=2)
+    assert h.shape == (B, H) and c.shape == (B, H)
+    assert np.isfinite(h).all()
+
+
+def test_row_conv_lookahead():
+    B, T, D, K = 1, 4, 2, 1
+    x = np.arange(8, dtype=np.float32).reshape(B, T, D)
+
+    def build():
+        v = L.data(name="x", shape=[T, D], dtype="float32")
+        return L.row_conv(v, future_context_size=K,
+                          param_attr=pt.ParamAttr(name="rowconv_w"))
+
+    scope = pt.Scope()
+    out, = _run_with_scope(lambda: [build()], {"x": x}, [0], scope)
+    w = np.asarray(scope.find_var("rowconv_w"))  # [K+1, D]
+    expect = np.zeros_like(x)
+    for t in range(T):
+        for i in range(K + 1):
+            if t + i < T:
+                expect[0, t] += x[0, t + i] * w[i]
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+# -- norms -------------------------------------------------------------------
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((4, 6)).astype(np.float32)
+
+    def build():
+        wv = L.data(name="w", shape=[4, 6], dtype="float32",
+                    append_batch_size=False)
+        return L.spectral_norm(wv, dim=0, power_iters=20)
+
+    out, = _run(build, {"w": w})
+    # after normalization the top singular value is ~1
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_data_norm_uses_accumulated_stats():
+    B, C = 4, 3
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((B, C)).astype(np.float32)
+
+    def build():
+        v = L.data(name="x", shape=[C], dtype="float32")
+        return L.data_norm(v, name="dn",
+                           param_attr={"batch_size": 100.0,
+                                       "batch_sum": 50.0,
+                                       "batch_square": 400.0})
+
+    out, = _run(build, {"x": x})
+    means = 50.0 / 100.0
+    scales = np.sqrt(100.0 / 400.0)
+    np.testing.assert_allclose(out, (x - means) * scales, rtol=1e-5)
+
+
+# -- losses ------------------------------------------------------------------
+
+def test_center_loss_distance_and_update():
+    B, D, NC = 3, 2, 4
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((B, D)).astype(np.float32)
+    lab = np.array([[1], [1], [3]], np.int64)
+
+    def build():
+        xv = L.data(name="x", shape=[D], dtype="float32")
+        lv = L.data(name="y", shape=[1], dtype="int64")
+        loss = L.center_loss(xv, lv, NC, alpha=0.5,
+                             param_attr=pt.ParamAttr(name="centers"),
+                             update_center=True)
+        return [loss]
+
+    scope = pt.Scope()
+    # capture initial centers by running startup in the same scope first
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            loss = build()[0]
+    exe = pt.Executor()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        c0 = np.asarray(scope.find_var("centers")).copy()
+        lv, = exe.run(main, feed={"x": x, "y": lab}, fetch_list=[loss])
+        c1 = np.asarray(scope.find_var("centers"))
+    expect = 0.5 * np.sum((x - c0[lab.reshape(-1)]) ** 2, axis=1,
+                          keepdims=True)
+    np.testing.assert_allclose(np.asarray(lv), expect, rtol=1e-5)
+    # class 1 (2 samples): c -= alpha/(1+2) * sum(c - x); class 0 unchanged
+    diff = (c0[1] - x[0]) + (c0[1] - x[1])
+    np.testing.assert_allclose(c1[1], c0[1] - 0.5 / 3.0 * diff, rtol=1e-5)
+    np.testing.assert_allclose(c1[0], c0[0])
+
+
+def test_cross_entropy2_matches_log():
+    x = np.array([[0.2, 0.5, 0.3], [0.9, 0.05, 0.05]], np.float32)
+    lab = np.array([[1], [0]], np.int64)
+
+    def build():
+        xv = L.data(name="x", shape=[3], dtype="float32")
+        lv = L.data(name="y", shape=[1], dtype="int64")
+        return L.cross_entropy2(xv, lv)
+
+    out, = _run(build, {"x": x, "y": lab})
+    np.testing.assert_allclose(
+        out.reshape(-1), -np.log([0.5, 0.9]), rtol=1e-5)
+
+
+def test_teacher_student_loss_finite_and_hard_case():
+    z = np.array([[2.0], [-3.0], [40.0]], np.float32)
+    lab = np.array([[1.0], [0.0], [1.0]], np.float32)
+
+    def build():
+        xv = L.data(name="x", shape=[1], dtype="float32")
+        lv = L.data(name="y", shape=[1], dtype="float32")
+        return L.teacher_student_sigmoid_loss(xv, lv)
+
+    out, = _run(build, {"x": z, "y": lab})
+    zc = np.clip(z, -15, 15).reshape(-1)
+    hard = lab.reshape(-1)
+    expect = np.maximum(zc, 0) - zc * hard + np.log1p(np.exp(-np.abs(zc)))
+    np.testing.assert_allclose(out.reshape(-1), expect, rtol=1e-5)
+
+
+def test_sampled_softmax_trains():
+    B, V = 4, 1000
+    rng = np.random.default_rng(8)
+
+    def build():
+        xv = L.data(name="x", shape=[16], dtype="float32")
+        lv = L.data(name="y", shape=[1], dtype="int64")
+        logits = L.fc(xv, size=V)
+        loss = L.mean(L.sampled_softmax_with_cross_entropy(
+            logits, lv, num_samples=20))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        return [loss]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            loss = build()[0]
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        x = rng.standard_normal((B, 16)).astype(np.float32)
+        y = rng.integers(0, V, (B, 1)).astype(np.int64)
+        first = None
+        for i in range(30):
+            lv, = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(lv))
+    assert np.isfinite(first)
+    assert float(np.asarray(lv)) < first  # loss decreases on fixed batch
+
+
+def test_npair_loss_builds_and_is_finite():
+    B, D = 4, 8
+    rng = np.random.default_rng(9)
+
+    def build():
+        a = L.data(name="a", shape=[D], dtype="float32")
+        p = L.data(name="p", shape=[D], dtype="float32")
+        lab = L.data(name="lab", shape=[B], dtype="float32",
+                     append_batch_size=False)
+        return L.npair_loss(a, p, lab)
+
+    out, = _run(build, {
+        "a": rng.standard_normal((B, D)).astype(np.float32),
+        "p": rng.standard_normal((B, D)).astype(np.float32),
+        "lab": np.array([0.0, 0.0, 1.0, 2.0], np.float32)})
+    assert np.isfinite(out).all()
+
+
+# -- decode / metrics --------------------------------------------------------
+
+def test_ctc_greedy_decoder_merges_and_drops():
+    # argmax path: tokens [1,1,0,2,2,0,3] -> decode [1,2,3]
+    T, V = 7, 4
+    probs = np.zeros((1, T, V), np.float32)
+    for t, tok in enumerate([1, 1, 0, 2, 2, 0, 3]):
+        probs[0, t, tok] = 1.0
+
+    def build():
+        v = L.data(name="p", shape=[T, V], dtype="float32")
+        ln = L.data(name="ln", shape=[], dtype="int64")
+        out, out_len = L.ctc_greedy_decoder(v, blank=0, input_length=ln)
+        return [out, out_len]
+
+    out, out_len = _run(lambda: build(),
+                        {"p": probs, "ln": np.array([T], np.int64)},
+                        n_fetch=2)
+    assert out_len[0] == 3
+    np.testing.assert_array_equal(out[0, :3], [1, 2, 3])
+    assert (out[0, 3:] == -1).all()
+
+
+def test_edit_distance_known_cases():
+    # kitten -> sitting = 3
+    def enc(s, T=8):
+        v = np.zeros(T, np.int64)
+        v[:len(s)] = [ord(c) for c in s]
+        return v, len(s)
+
+    h, hl = enc("kitten")
+    r, rl = enc("sitting")
+
+    def build():
+        hv = L.data(name="h", shape=[8], dtype="int64")
+        rv = L.data(name="r", shape=[8], dtype="int64")
+        hlv = L.data(name="hl", shape=[], dtype="int64")
+        rlv = L.data(name="rl", shape=[], dtype="int64")
+        d, n = L.edit_distance(hv, rv, normalized=False,
+                               input_length=hlv, label_length=rlv)
+        return [d, n]
+
+    d, n = _run(lambda: build(),
+                {"h": h[None], "r": r[None],
+                 "hl": np.array([hl], np.int64),
+                 "rl": np.array([rl], np.int64)}, n_fetch=2)
+    assert float(d[0, 0]) == 3.0
+    assert int(n[0]) == 1
+
+
+def test_chunk_eval_iob():
+    # 2 types, IOB: tags B-0=0 I-0=1 B-1=2 I-1=3, O = anything out of range
+    inf = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+    lab = np.array([[0, 1, 4, 2, 1, 4]], np.int64)
+
+    def build():
+        iv = L.data(name="i", shape=[6], dtype="int64")
+        lv = L.data(name="l", shape=[6], dtype="int64")
+        return list(L.chunk_eval(iv, lv, "IOB", 2))
+
+    p, r, f1 = _run(lambda: build(), {"i": inf, "l": lab}, n_fetch=3)
+    # infer chunks: (0,[0,1]), (1,[3,4]); label: (0,[0,1]), (1,[3,3]),(0,[4,4])
+    assert abs(float(p[0]) - 0.5) < 1e-6      # 1 correct of 2 inferred
+    assert abs(float(r[0]) - 1.0 / 3.0) < 1e-6
+
+
+# -- escape hatch ------------------------------------------------------------
+
+def test_py_func_forward_and_backward():
+    def fwd(a):
+        return a * 3.0
+
+    def bwd(a, out, dout):
+        return dout * 3.0
+
+    def build():
+        v = L.data(name="x", shape=[4], dtype="float32")
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("pyf")
+        out = helper.create_variable_for_type_inference("float32")
+        out.shape = (-1, 4)
+        L.py_func(fwd, v, out, backward_func=bwd)
+        loss = L.mean(out)
+        pt.optimizer.SGD(1.0).minimize(loss)
+        return [out, loss]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            out, loss = build()
+    exe = pt.Executor()
+    x = np.ones((2, 4), np.float32)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ov, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(ov), x * 3.0)
+
+
+# -- misc tensor -------------------------------------------------------------
+
+def test_unique_and_counts_host_ops():
+    x = np.array([3, 1, 3, 2, 1, 3], np.int64)
+
+    def build():
+        v = L.data(name="x", shape=[6], dtype="int64",
+                   append_batch_size=False)
+        u, idx = L.unique(v)
+        u2, idx2, cnt = L.unique_with_counts(v)
+        return [u, idx, cnt]
+
+    u, idx, cnt = _run(lambda: build(), {"x": x}, n_fetch=3)
+    np.testing.assert_array_equal(u, [3, 1, 2])   # first-occurrence order
+    np.testing.assert_array_equal(idx, [0, 1, 0, 2, 1, 0])
+    np.testing.assert_array_equal(cnt, [3, 2, 1])
+
+
+def test_hash_buckets_and_shape():
+    x = np.array([[1], [2], [1]], np.int64)
+
+    def build():
+        v = L.data(name="x", shape=[3, 1], dtype="int64",
+                   append_batch_size=False)
+        return L.hash(v, hash_size=1000, num_hash=2)
+
+    out, = _run(build, {"x": x})
+    assert out.shape == (3, 2, 1)
+    assert (out >= 0).all() and (out < 1000).all()
+    np.testing.assert_array_equal(out[0], out[2])  # same id -> same buckets
+    assert (out[0] != out[1]).any()
+
+
+def test_cvm_transform_and_strip():
+    x = np.array([[3.0, 1.0, 5.0, 6.0]], np.float32)
+    cvm_feat = np.array([[1.0, 0.5]], np.float32)
+
+    def build():
+        v = L.data(name="x", shape=[4], dtype="float32")
+        c = L.data(name="c", shape=[2], dtype="float32")
+        return [L.continuous_value_model(v, c, use_cvm=True),
+                L.continuous_value_model(v, c, use_cvm=False)]
+
+    keep, strip = _run(lambda: build(),
+                       {"x": x, "c": cvm_feat}, n_fetch=2)
+    np.testing.assert_allclose(
+        keep[0], [np.log(4.0), np.log(2.0) - np.log(4.0), 5.0, 6.0],
+        rtol=1e-6)
+    np.testing.assert_allclose(strip[0], [5.0, 6.0])
+
+
+def test_tree_conv_root_only_weights():
+    """Single-node 'tree' (no edges): patch = root with eta_t=1, eta_l=
+    eta_r=0 -> out = f @ W[:, 2] (the t-component)."""
+    B, N, F, O, M = 1, 3, 4, 5, 1
+    rng = np.random.default_rng(10)
+    feat = rng.standard_normal((B, N, F)).astype(np.float32)
+    edges = np.zeros((B, 2, 2), np.int64)  # no valid edges
+
+    def build():
+        nv = L.data(name="nv", shape=[N, F], dtype="float32")
+        ev = L.data(name="ev", shape=[2, 2], dtype="int64")
+        return L.tree_conv(nv, ev, O, M, max_depth=2, act=None,
+                           bias_attr=False,
+                           param_attr=pt.ParamAttr(name="tree_w"))
+
+    scope = pt.Scope()
+    out, = _run_with_scope(lambda: [build()], {"nv": feat, "ev": edges},
+                           [0], scope)
+    w = np.asarray(scope.find_var("tree_w"))  # [F, 3, O, M]
+    # only node 1 exists (the implicit root); its patch is itself
+    expect = np.einsum("f,fom->om", feat[0, 0], w[:, 2])
+    np.testing.assert_allclose(out[0, 0], expect, rtol=2e-5, atol=1e-5)
+    np.testing.assert_allclose(out[0, 1:], 0.0, atol=1e-6)
+
+
+def test_tree_conv_parent_child():
+    """Root 1 with children 2, 3 (max_depth 2): root's patch = {1,2,3}."""
+    B, N, F, O = 1, 3, 2, 2
+    feat = np.array([[[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]]], np.float32)
+    edges = np.array([[[1, 2], [1, 3]]], np.int64)
+
+    def build():
+        nv = L.data(name="nv", shape=[N, F], dtype="float32")
+        ev = L.data(name="ev", shape=[2, 2], dtype="int64")
+        return L.tree_conv(nv, ev, O, 1, max_depth=2, act=None,
+                           bias_attr=False,
+                           param_attr=pt.ParamAttr(name="tree_w2"))
+
+    scope = pt.Scope()
+    out, = _run_with_scope(lambda: [build()],
+                           {"nv": feat, "ev": edges}, [0], scope)
+    w = np.asarray(scope.find_var("tree_w2"))  # [F, 3, O, 1]
+    # every patch node contributes ALL THREE eta components (tree2col.cc):
+    # root (node 1): eta_t=1, eta_l=eta_r=0
+    # child 2: depth 1, index 1, pclen 2 -> eta_t=.5, eta_l=0, eta_r=.5
+    # child 3: depth 1, index 2, pclen 2 -> eta_t=.5, eta_l=.5, eta_r=0
+    p_l = 0.5 * feat[0, 2]
+    p_r = 0.5 * feat[0, 1]
+    p_t = feat[0, 0] + 0.5 * feat[0, 1] + 0.5 * feat[0, 2]
+    patch = (np.einsum("f,fom->om", p_l, w[:, 0])
+             + np.einsum("f,fom->om", p_r, w[:, 1])
+             + np.einsum("f,fom->om", p_t, w[:, 2]))
+    np.testing.assert_allclose(out[0, 0], patch, rtol=2e-5, atol=1e-5)
+
+
+# -- vision additions --------------------------------------------------------
+
+def test_resize_trilinear_and_adaptive_pool3d():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 2, 2, 4)
+
+    def build():
+        v = L.data(name="x", shape=[1, 2, 2, 4], dtype="float32")
+        r = L.resize_trilinear(v, out_shape=(2, 2, 2), align_corners=True)
+        p = L.adaptive_pool3d(v, [1, 1, 2], "avg")
+        return [r, p]
+
+    r, p = _run(lambda: build(), {"x": x}, n_fetch=2)
+    # align_corners 4->2 on last axis picks cols 0 and 3
+    np.testing.assert_allclose(r[0, 0, :, :, 0], x[0, 0, :, :, 0])
+    np.testing.assert_allclose(r[0, 0, :, :, 1], x[0, 0, :, :, 3])
+    # avg bins: D 2->1, H 2->1, W 4->2 (pairs)
+    expect = x[0, 0].mean(axis=(0, 1)).reshape(2, 2).mean(axis=1)
+    np.testing.assert_allclose(p[0, 0].reshape(-1), expect, rtol=1e-5)
+
+
+def test_im2sequence_windows():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build():
+        v = L.data(name="x", shape=[1, 4, 4], dtype="float32")
+        return L.im2sequence(v, filter_size=2, stride=2)
+
+    out, = _run(build, {"x": x})
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_allclose(out[0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(out[0, 3], [10, 11, 14, 15])
+
+
+def test_random_crop_shape_and_content():
+    x = np.arange(2 * 1 * 6 * 6, dtype=np.float32).reshape(2, 1, 6, 6)
+
+    def build():
+        v = L.data(name="x", shape=[1, 6, 6], dtype="float32")
+        return L.random_crop(v, shape=[4, 4])
+
+    out, = _run(build, {"x": x})
+    assert out.shape == (2, 1, 4, 4)
+    # crops are contiguous windows: row deltas of 1 within a row
+    assert np.allclose(np.diff(out[0, 0], axis=1), 1.0)
+
+
+def test_conv3d_transpose_shape():
+    x = np.random.default_rng(11).standard_normal(
+        (1, 2, 3, 3, 3)).astype(np.float32)
+
+    def build():
+        v = L.data(name="x", shape=[2, 3, 3, 3], dtype="float32")
+        return L.conv3d_transpose(v, num_filters=4, filter_size=2, stride=2,
+                                  bias_attr=False)
+
+    out, = _run(build, {"x": x})
+    assert out.shape == (1, 4, 6, 6, 6)
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    """With zero offsets and unit mask, deformable conv IS a plain conv."""
+    B, C, H, W, F, K = 1, 2, 5, 5, 3, 3
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((B, C, H, W)).astype(np.float32)
+    OH = OW = H - K + 1
+
+    def build():
+        v = L.data(name="x", shape=[C, H, W], dtype="float32")
+        off = L.data(name="off", shape=[2 * K * K, OH, OW], dtype="float32")
+        msk = L.data(name="msk", shape=[K * K, OH, OW], dtype="float32")
+        out = L.deformable_conv(v, off, msk, F, K, padding=0,
+                                bias_attr=False,
+                                param_attr=pt.ParamAttr(name="dcn_w"))
+        return [out]
+
+    scope = pt.Scope()
+    out, = _run_with_scope(
+        lambda: build(),
+        {"x": x, "off": np.zeros((B, 2 * K * K, OH, OW), np.float32),
+         "msk": np.ones((B, K * K, OH, OW), np.float32)}, [0], scope)
+    w = np.asarray(scope.find_var("dcn_w"))
+    import jax
+    expect = np.asarray(jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_affine_grid_identity_theta():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (2, 1, 1))
+
+    def build():
+        t = L.data(name="t", shape=[2, 3], dtype="float32")
+        return L.affine_grid(t, out_shape=[2, 1, 3, 4])
+
+    out, = _run(build, {"t": theta})
+    assert out.shape == (2, 3, 4, 2)
+    np.testing.assert_allclose(out[0, 0, :, 0], np.linspace(-1, 1, 4),
+                               rtol=1e-6)
+    np.testing.assert_allclose(out[0, :, 0, 1], np.linspace(-1, 1, 3),
+                               rtol=1e-6)
+
+
+def test_gaussian_uniform_batch_size_like():
+    def build():
+        v = L.data(name="x", shape=[7], dtype="float32")
+        g = L.gaussian_random_batch_size_like(v, shape=[-1, 5], std=2.0)
+        u = L.uniform_random_batch_size_like(v, shape=[-1, 4])
+        return [g, u]
+
+    g, u = _run(lambda: build(),
+                {"x": np.zeros((6, 7), np.float32)}, n_fetch=2)
+    assert g.shape == (6, 5) and u.shape == (6, 4)
+    assert (u >= -1).all() and (u <= 1).all()
+
+
+def test_autoincreased_step_counter():
+    def build():
+        return [L.autoincreased_step_counter(begin=1)]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            ctr = build()[0]
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        vals = [int(np.asarray(exe.run(main, feed={}, fetch_list=[ctr])[0]))
+                for _ in range(3)]
+    assert vals == [1, 2, 3]
+
+
+def test_ctc_padding_value_and_ce2_ignore_index():
+    T, V = 4, 3
+    probs = np.zeros((1, T, V), np.float32)
+    for t, tok in enumerate([1, 0, 2, 2]):
+        probs[0, t, tok] = 1.0
+
+    def build():
+        v = L.data(name="p", shape=[T, V], dtype="float32")
+        ln = L.data(name="ln", shape=[], dtype="int64")
+        out, _ = L.ctc_greedy_decoder(v, blank=0, input_length=ln,
+                                      padding_value=0)
+        x = L.data(name="x", shape=[3], dtype="float32")
+        y = L.data(name="y", shape=[1], dtype="int64")
+        ce = L.cross_entropy2(x, y, ignore_index=-100)
+        return [out, ce]
+
+    out, ce = _run(lambda: build(),
+                   {"p": probs, "ln": np.array([T], np.int64),
+                    "x": np.array([[0.2, 0.5, 0.3], [0.1, 0.1, 0.8]],
+                                  np.float32),
+                    "y": np.array([[1], [-100]], np.int64)}, n_fetch=2)
+    np.testing.assert_array_equal(out[0], [1, 2, 0, 0])  # pad 0, not -1
+    np.testing.assert_allclose(ce.reshape(-1), [-np.log(0.5), 0.0],
+                               rtol=1e-5)
+
+
+def test_edit_distance_with_ignored_tokens_no_length():
+    h = np.array([[1, 0, 2, 0]], np.int64)
+    r = np.array([[1, 2, 0, 0]], np.int64)
+
+    def build():
+        hv = L.data(name="h", shape=[4], dtype="int64")
+        rv = L.data(name="r", shape=[4], dtype="int64")
+        d, n = L.edit_distance(hv, rv, normalized=False,
+                               ignored_tokens=[0])
+        return [d]
+
+    d, = _run(lambda: build(), {"h": h, "r": r})
+    assert float(d[0, 0]) == 0.0  # both erase to [1, 2]
