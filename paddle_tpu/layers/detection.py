@@ -8,7 +8,9 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["prior_box", "box_coder", "iou_similarity", "multiclass_nms",
-           "detection_output", "ssd_loss"]
+           "detection_output", "ssd_loss", "bipartite_match",
+           "yolo_box", "yolov3_loss", "anchor_generator",
+           "density_prior_box", "generate_proposals", "psroi_pool"]
 
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
@@ -125,4 +127,142 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
          "loc_loss_weight": float(loc_loss_weight),
          "conf_loss_weight": float(conf_loss_weight),
          "normalize": bool(normalize)})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """reference detection.py bipartite_match / bipartite_match_op.cc."""
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    d = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(
+        "bipartite_match", {"DistMat": [dist_matrix]},
+        {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [d]},
+        {"match_type": match_type or "bipartite",
+         "dist_threshold": float(dist_threshold or 0.5)})
+    return idx, d
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, name=None):
+    """reference detection.py yolo_box / detection/yolo_box_op.cc."""
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolo_box", {"X": [x], "ImgSize": [img_size]},
+        {"Boxes": [boxes], "Scores": [scores]},
+        {"anchors": [int(a) for a in anchors], "class_num": int(class_num),
+         "conf_thresh": float(conf_thresh),
+         "downsample_ratio": int(downsample_ratio)})
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """reference detection.py yolov3_loss / detection/yolov3_loss_op.h."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    obj_mask = helper.create_variable_for_type_inference(x.dtype)
+    gt_match = helper.create_variable_for_type_inference("int32")
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score]
+    helper.append_op(
+        "yolov3_loss", ins,
+        {"Loss": [loss], "ObjectnessMask": [obj_mask],
+         "GTMatchMask": [gt_match]},
+        {"anchors": [int(a) for a in anchors],
+         "anchor_mask": [int(m) for m in anchor_mask],
+         "class_num": int(class_num),
+         "ignore_thresh": float(ignore_thresh),
+         "downsample_ratio": int(downsample_ratio),
+         "use_label_smooth": bool(use_label_smooth)})
+    return loss
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    """reference detection.py anchor_generator."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    variances = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "anchor_generator", {"Input": [input]},
+        {"Anchors": [anchors], "Variances": [variances]},
+        {"anchor_sizes": [float(s) for s in (anchor_sizes or [64, 128, 256,
+                                                              512])],
+         "aspect_ratios": [float(r) for r in (aspect_ratios or [0.5, 1.0,
+                                                                2.0])],
+         "variances": [float(v) for v in (variance or [0.1, 0.1, 0.2, 0.2])],
+         "stride": [float(s) for s in (stride or [16.0, 16.0])],
+         "offset": float(offset)})
+    anchors.stop_gradient = True
+    variances.stop_gradient = True
+    return anchors, variances
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=None, clip=False,
+                      steps=None, offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """reference detection.py density_prior_box."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        "density_prior_box", {"Input": [input], "Image": [image]},
+        {"Boxes": [boxes], "Variances": [var]},
+        {"densities": [int(d) for d in (densities or [])],
+         "fixed_sizes": [float(s) for s in (fixed_sizes or [])],
+         "fixed_ratios": [float(r) for r in (fixed_ratios or [])],
+         "variances": [float(v) for v in (variance or [0.1, 0.1, 0.2,
+                                                       0.2])],
+         "clip": bool(clip), "step_w": float(steps[0]),
+         "step_h": float(steps[1]), "offset": float(offset),
+         "flatten_to_2d": bool(flatten_to_2d)})
+    boxes.stop_gradient = True
+    var.stop_gradient = True
+    return boxes, var
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference detection.py generate_proposals."""
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    roi_probs = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        "generate_proposals",
+        {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+         "ImInfo": [im_info], "Anchors": [anchors],
+         "Variances": [variances]},
+        {"RpnRois": [rois], "RpnRoiProbs": [roi_probs]},
+        {"pre_nms_topN": int(pre_nms_top_n),
+         "post_nms_topN": int(post_nms_top_n),
+         "nms_thresh": float(nms_thresh), "min_size": float(min_size),
+         "eta": float(eta)})
+    rois.stop_gradient = True
+    roi_probs.stop_gradient = True
+    return rois, roi_probs
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_batch=None, name=None):
+    """reference nn.py psroi_pool / psroi_pool_op.h."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    helper.append_op(
+        "psroi_pool", ins, {"Out": [out]},
+        {"output_channels": int(output_channels),
+         "spatial_scale": float(spatial_scale),
+         "pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width)})
     return out

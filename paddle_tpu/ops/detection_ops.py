@@ -697,3 +697,188 @@ def generate_proposals(ctx: ExecContext):
     rois, probs, counts = jax.vmap(one)(sc, dl, im_info)
     return {"RpnRois": rois, "RpnRoiProbs": probs[..., None],
             "RpnRoisNum": counts}
+
+
+def _sce(x, t):
+    """SigmoidCrossEntropy exactly as yolov3_loss_op.h:129."""
+    return jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("yolov3_loss")
+def yolov3_loss(ctx: ExecContext):
+    """YOLOv3 training loss (reference detection/yolov3_loss_op.h, CPU
+    kernel reproduced as one vectorized jnp computation; grads derive via
+    vjp and match the kernel's analytic sce/l1 gradients).
+
+    X [N, mask*(5+cls), H, W]; GTBox [N, B, 4] (cx, cy, w, h normalized to
+    the input image); GTLabel [N, B] int; optional GTScore [N, B] (mixup).
+    Outputs Loss [N], ObjectnessMask [N, mask, H, W], GTMatchMask [N, B]."""
+    x = ctx.input("X").astype(jnp.float32)
+    gt_box = ctx.input("GTBox").astype(jnp.float32)
+    gt_label = ctx.input("GTLabel").astype(jnp.int32)
+    if gt_label.ndim == 3:
+        gt_label = gt_label.reshape(gt_label.shape[:2])
+    anchors = [int(a) for a in ctx.attr("anchors")]
+    mask = [int(m) for m in ctx.attr("anchor_mask")]
+    class_num = int(ctx.attr("class_num"))
+    ignore_thresh = float(ctx.attr("ignore_thresh", 0.7))
+    downsample = int(ctx.attr("downsample_ratio", 32))
+    use_smooth = bool(ctx.attr("use_label_smooth", True))
+    N, _, H, W = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(mask)
+    B = gt_box.shape[1]
+    input_size = downsample * H
+    if ctx.has_input("GTScore"):
+        gt_score = ctx.input("GTScore").astype(jnp.float32)
+        if gt_score.ndim == 3:
+            gt_score = gt_score.reshape(gt_score.shape[:2])
+    else:
+        gt_score = jnp.ones((N, B), jnp.float32)
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_smooth:
+        delta = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - delta, delta
+
+    xr = x.reshape(N, mask_num, 5 + class_num, H, W)
+    valid = (gt_box[:, :, 2] > 1e-6) & (gt_box[:, :, 3] > 1e-6)  # [N, B]
+
+    # --- ignore pass: best IoU of every prediction against every gt ------
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, :, None]
+    aw = jnp.asarray([anchors[2 * m] for m in mask],
+                     jnp.float32)[:, None, None]
+    ah = jnp.asarray([anchors[2 * m + 1] for m in mask],
+                     jnp.float32)[:, None, None]
+    px = (jax.nn.sigmoid(xr[:, :, 0]) + grid_x) / W       # [N, mask, H, W]
+    py = (jax.nn.sigmoid(xr[:, :, 1]) + grid_y) / H
+    pw = jnp.exp(xr[:, :, 2]) * aw[None] / input_size
+    ph = jnp.exp(xr[:, :, 3]) * ah[None] / input_size
+
+    def iou(cx1, cy1, w1, h1, cx2, cy2, w2, h2):
+        ow = jnp.minimum(cx1 + w1 / 2, cx2 + w2 / 2) - \
+            jnp.maximum(cx1 - w1 / 2, cx2 - w2 / 2)
+        oh = jnp.minimum(cy1 + h1 / 2, cy2 + h2 / 2) - \
+            jnp.maximum(cy1 - h1 / 2, cy2 - h2 / 2)
+        inter = jnp.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+        return inter / (w1 * h1 + w2 * h2 - inter + 1e-10)
+
+    g = gt_box[:, :, None, None, None, :]                 # [N, B, 1,1,1, 4]
+    ious = iou(px[:, None], py[:, None], pw[:, None], ph[:, None],
+               g[..., 0], g[..., 1], g[..., 2], g[..., 3])  # [N,B,mask,H,W]
+    ious = jnp.where(valid[:, :, None, None, None], ious, 0.0)
+    best_iou = jax.lax.stop_gradient(ious.max(axis=1))    # [N, mask, H, W]
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+    # --- positive pass: per gt, best anchor over the FULL anchor list ----
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    gw = gt_box[:, :, 2][:, :, None]
+    gh = gt_box[:, :, 3][:, :, None]
+    an_iou = iou(jnp.zeros_like(gw), jnp.zeros_like(gw), gw, gh,
+                 0.0, 0.0, all_aw[None, None], all_ah[None, None])
+    best_n = jnp.argmax(an_iou, axis=2).astype(jnp.int32)  # [N, B]
+    mask_lookup = -jnp.ones((an_num,), jnp.int32)
+    for mi, m in enumerate(mask):
+        mask_lookup = mask_lookup.at[m].set(mi)
+    mask_idx = mask_lookup[best_n]                         # [N, B]
+    gt_match = jnp.where(valid, mask_idx, -1)
+
+    gi = jnp.clip((gt_box[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+    pos = valid & (mask_idx >= 0)
+    m_safe = jnp.maximum(mask_idx, 0)
+    bidx = jnp.arange(N)[:, None]
+    # gather the responsible cell's raw predictions: [N, B, 5+cls]
+    cell = xr[bidx, m_safe, :, gj, gi]
+    tx = gt_box[:, :, 0] * W - gi.astype(jnp.float32)
+    ty = gt_box[:, :, 1] * H - gj.astype(jnp.float32)
+    aw_best = jnp.take(jnp.asarray(anchors[0::2], jnp.float32), best_n)
+    ah_best = jnp.take(jnp.asarray(anchors[1::2], jnp.float32), best_n)
+    tw = jnp.log(jnp.maximum(gt_box[:, :, 2] * input_size, 1e-9) / aw_best)
+    th = jnp.log(jnp.maximum(gt_box[:, :, 3] * input_size, 1e-9) / ah_best)
+    scale = (2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]) * gt_score
+    loc = (_sce(cell[:, :, 0], tx) + _sce(cell[:, :, 1], ty)
+           + jnp.abs(cell[:, :, 2] - tw) + jnp.abs(cell[:, :, 3] - th))
+    loc_loss = jnp.where(pos, loc * scale, 0.0).sum(axis=1)   # [N]
+
+    cls_t = jnp.where(
+        jax.nn.one_hot(gt_label, class_num) > 0.5, label_pos, label_neg)
+    cls = _sce(cell[:, :, 5:], cls_t).sum(axis=2)
+    cls_loss = jnp.where(pos, cls * gt_score, 0.0).sum(axis=1)
+
+    # positive cells override the ignore mark with their (mixup) score;
+    # later gts win on collision, like the reference's sequential writes
+    def write_obj(om, t):
+        val = jnp.where(pos[:, t], gt_score[:, t], om[bidx[:, 0], m_safe[:, t],
+                                                      gj[:, t], gi[:, t]])
+        return om.at[bidx[:, 0], m_safe[:, t], gj[:, t], gi[:, t]].set(val), None
+
+    for t in range(B):
+        obj_mask, _ = write_obj(obj_mask, t)
+
+    obj_logit = xr[:, :, 4]
+    obj_pos = jnp.where(obj_mask > 1e-5,
+                        _sce(obj_logit, 1.0) * obj_mask, 0.0)
+    obj_neg = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                        _sce(obj_logit, 0.0), 0.0)
+    obj_loss = (obj_pos + obj_neg).sum(axis=(1, 2, 3))
+
+    loss = loc_loss + cls_loss + obj_loss
+    return {"Loss": loss.astype(ctx.input("X").dtype),
+            "ObjectnessMask": jax.lax.stop_gradient(obj_mask),
+            "GTMatchMask": gt_match}
+
+
+@register_op("psroi_pool")
+def psroi_pool(ctx: ExecContext):
+    """Position-sensitive RoI pooling (reference psroi_pool_op.h): input
+    channel c*ph*pw + i*pw + j feeds output channel c's bin (i, j); average
+    over the bin's spatial extent. X [N, O*ph*pw, H, W], ROIs [R, 4]
+    (x1, y1, x2, y2) + RoisBatch [R] -> Out [R, O, ph, pw]."""
+    x = ctx.input("X").astype(jnp.float32)
+    rois = ctx.input("ROIs").astype(jnp.float32)
+    out_ch = int(ctx.attr("output_channels"))
+    ph = int(ctx.attr("pooled_height"))
+    pw = int(ctx.attr("pooled_width"))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    if ctx.has_input("RoisBatch"):
+        roi_batch = ctx.input("RoisBatch").reshape(-1).astype(jnp.int32)
+    else:
+        roi_batch = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    def pool_one(roi, b):
+        # reference: round then offset, bins at least 0.1 wide
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1.0) * scale
+        y2 = (jnp.round(roi[3]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        img = x[b]                                       # [C, H, W]
+        ys = jnp.arange(H, dtype=jnp.float32)[None, :]   # vs bin starts
+        xs = jnp.arange(W, dtype=jnp.float32)[None, :]
+        i = jnp.arange(ph, dtype=jnp.float32)[:, None]
+        j = jnp.arange(pw, dtype=jnp.float32)[:, None]
+        hstart = jnp.floor(y1 + i * bh)
+        hend = jnp.ceil(y1 + (i + 1) * bh)
+        wstart = jnp.floor(x1 + j * bw)
+        wend = jnp.ceil(x1 + (j + 1) * bw)
+        in_h = (ys >= jnp.clip(hstart, 0, H)) & \
+            (ys < jnp.clip(hend, 0, H))                  # [ph, H]
+        in_w = (xs >= jnp.clip(wstart, 0, W)) & \
+            (xs < jnp.clip(wend, 0, W))                  # [pw, W]
+        bin_mask = in_h[:, None, :, None] & in_w[None, :, None, :]
+        # channels: out channel o's bin (i,j) reads input o*ph*pw + i*pw + j
+        imgr = img.reshape(out_ch, ph, pw, H, W)
+        sums = jnp.einsum("oijhw,ijhw->oij", imgr,
+                          bin_mask.astype(jnp.float32))
+        counts = bin_mask.sum(axis=(2, 3)).astype(jnp.float32)
+        return jnp.where(counts[None] > 0, sums / jnp.maximum(counts, 1.0),
+                         0.0)
+
+    out = jax.vmap(pool_one)(rois, roi_batch)
+    return {"Out": out.astype(ctx.input("X").dtype)}

@@ -7,6 +7,19 @@ import paddle_tpu as pt
 from paddle_tpu import layers as L
 
 
+def _run(build, feeds, n_fetch=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=list(outs)[:n_fetch])
+    return [np.asarray(v) for v in vals]
+
+
 def test_prior_box_count_and_geometry():
     feat = L.data(name="feat", shape=[8, 2, 2], dtype="float32")
     img = L.data(name="img", shape=[3, 32, 32], dtype="float32")
@@ -126,3 +139,240 @@ def test_ssd_loss_trains_toy_detector():
             first = float(lv)
         last = float(lv)
     assert np.isfinite(last) and last < first * 0.8, (first, last)
+
+
+# -- round-4 tail: yolo family, anchors, proposals, psroi ------------------
+
+
+def _np_yolov3_loss(x, gt_box, gt_label, anchors, mask, class_num,
+                    ignore_thresh, downsample, use_smooth=True):
+    """Direct numpy port of reference yolov3_loss_op.h (the oracle)."""
+    def sce(v, t):
+        return max(v, 0.0) - v * t + np.log1p(np.exp(-abs(v)))
+
+    N, _, H, W = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(mask)
+    B = gt_box.shape[1]
+    input_size = downsample * H
+    pos_l, neg_l = 1.0, 0.0
+    if use_smooth:
+        d = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - d, d
+    xr = x.reshape(N, mask_num, 5 + class_num, H, W)
+    loss = np.zeros(N)
+    obj_mask = np.zeros((N, mask_num, H, W))
+
+    def iou(b1, b2):
+        ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - \
+            max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - \
+            max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        inter = 0.0 if (ow < 0 or oh < 0) else ow * oh
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter + 1e-10)
+
+    for i in range(N):
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    px = (l + 1 / (1 + np.exp(-xr[i, j, 0, k, l]))) / W
+                    py = (k + 1 / (1 + np.exp(-xr[i, j, 1, k, l]))) / H
+                    pw = np.exp(xr[i, j, 2, k, l]) * anchors[2 * mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) * anchors[2 * mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(B):
+                        if gt_box[i, t, 2] <= 1e-6 or gt_box[i, t, 3] <= 1e-6:
+                            continue
+                        best = max(best, iou((px, py, pw, ph), gt_box[i, t]))
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, l] = -1
+        for t in range(B):
+            if gt_box[i, t, 2] <= 1e-6 or gt_box[i, t, 3] <= 1e-6:
+                continue
+            gi = int(gt_box[i, t, 0] * W)
+            gj = int(gt_box[i, t, 1] * H)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                ab = (0, 0, anchors[2 * a] / input_size,
+                      anchors[2 * a + 1] / input_size)
+                v = iou(ab, (0, 0, gt_box[i, t, 2], gt_box[i, t, 3]))
+                if v > best_iou:
+                    best_iou, best_n = v, a
+            if best_n not in mask:
+                continue
+            mj = mask.index(best_n)
+            tx = gt_box[i, t, 0] * W - gi
+            ty = gt_box[i, t, 1] * H - gj
+            tw = np.log(gt_box[i, t, 2] * input_size / anchors[2 * best_n])
+            th = np.log(gt_box[i, t, 3] * input_size / anchors[2 * best_n + 1])
+            s = 2.0 - gt_box[i, t, 2] * gt_box[i, t, 3]
+            loss[i] += (sce(xr[i, mj, 0, gj, gi], tx)
+                        + sce(xr[i, mj, 1, gj, gi], ty)
+                        + abs(xr[i, mj, 2, gj, gi] - tw)
+                        + abs(xr[i, mj, 3, gj, gi] - th)) * s
+            obj_mask[i, mj, gj, gi] = 1.0
+            for c in range(class_num):
+                tgt = pos_l if c == gt_label[i, t] else neg_l
+                loss[i] += sce(xr[i, mj, 5 + c, gj, gi], tgt)
+        for j in range(mask_num):
+            for k in range(H):
+                for l in range(W):
+                    o = obj_mask[i, j, k, l]
+                    if o > 1e-5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += sce(xr[i, j, 4, k, l], 0.0)
+    return loss
+
+
+def test_yolov3_loss_matches_reference_port():
+    rng = np.random.default_rng(0)
+    N, H, W, class_num = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1]
+    x = rng.standard_normal((N, len(mask) * (5 + class_num), H, W)) \
+        .astype(np.float32)
+    gt_box = np.array([[[0.3, 0.4, 0.2, 0.3], [0.7, 0.2, 0.1, 0.1],
+                        [0.0, 0.0, 0.0, 0.0]],
+                       [[0.5, 0.5, 0.4, 0.5], [0.0, 0.0, 0.0, 0.0],
+                        [0.0, 0.0, 0.0, 0.0]]], np.float32)
+    gt_label = np.array([[1, 2, 0], [0, 0, 0]], np.int64)
+
+    def build():
+        xv = L.data(name="x", shape=list(x.shape[1:]), dtype="float32")
+        gb = L.data(name="gb", shape=[3, 4], dtype="float32")
+        gl = L.data(name="gl", shape=[3], dtype="int64")
+        return L.yolov3_loss(xv, gb, gl, anchors, mask, class_num,
+                             ignore_thresh=0.7, downsample_ratio=32)
+
+    out, = _run(build, {"x": x, "gb": gt_box, "gl": gt_label})
+    expect = _np_yolov3_loss(x.astype(np.float64), gt_box, gt_label,
+                             anchors, mask, class_num, 0.7, 32)
+    np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-4)
+
+
+def test_yolov3_loss_trains():
+    """YOLO-head forward/backward smoke: conv head -> yolov3_loss -> SGD
+    step decreases the loss on a fixed batch."""
+    rng = np.random.default_rng(1)
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    class_num = 2
+    img = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+    gt_box = np.array([[[0.4, 0.4, 0.3, 0.3]], [[0.6, 0.6, 0.2, 0.4]]],
+                      np.float32)
+    gt_label = np.zeros((2, 1), np.int64)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            iv = L.data(name="img", shape=[3, 32, 32], dtype="float32")
+            gb = L.data(name="gb", shape=[1, 4], dtype="float32")
+            gl = L.data(name="gl", shape=[1], dtype="int64")
+            feat = L.conv2d(iv, num_filters=len(mask) * (5 + class_num),
+                            filter_size=3, stride=32, padding=1, act=None)
+            loss = L.reduce_mean(L.yolov3_loss(
+                feat, gb, gl, anchors, mask, class_num, 0.7, 32))
+            pt.optimizer.SGD(0.01).minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        feed = {"img": img, "gb": gt_box, "gl": gt_label}
+        first = float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss])[0]))
+        for _ in range(10):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(np.asarray(lv)) < first
+
+
+def test_yolo_box_decodes_center_box():
+    anchors = [32, 32]
+    N, H, W, cls = 1, 2, 2, 1
+    x = np.zeros((N, 5 + cls, H, W), np.float32)
+    x[0, 4] = 5.0   # high conf everywhere
+    x[0, 5] = 5.0
+
+    def build():
+        xv = L.data(name="x", shape=[5 + cls, H, W], dtype="float32")
+        sz = L.data(name="sz", shape=[2], dtype="int64")
+        b, s = L.yolo_box(xv, sz, anchors, cls, 0.01, 32)
+        return [b, s]
+
+    boxes, scores = _run(lambda: build(),
+                         {"x": x, "sz": np.array([[64, 64]], np.int64)},
+                         n_fetch=2)
+    # cell (0,0): cx = 0.5/2 -> 16 px; box w = 32/64 -> 32 px
+    np.testing.assert_allclose(boxes[0, 0], [0.0, 0.0, 31.0, 31.0],
+                               atol=1.5)
+    assert scores[0, 0, 0] > 0.9
+
+
+def test_psroi_pool_average_bins():
+    # X: 8 channels = 2 out channels * 2x2 bins; one roi covering all 4x4
+    O, ph, pw = 2, 2, 2
+    x = np.zeros((1, O * ph * pw, 4, 4), np.float32)
+    for c in range(O * ph * pw):
+        x[0, c] = c  # constant planes
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+
+    def build():
+        xv = L.data(name="x", shape=[O * ph * pw, 4, 4], dtype="float32")
+        rv = L.data(name="r", shape=[4], dtype="float32",
+                    append_batch_size=False)
+        return L.psroi_pool(xv, rv, O, 1.0, ph, pw)
+
+    out, = _run(build, {"x": x, "r": rois})
+    assert out.shape == (1, O, ph, pw)
+    # out channel o bin (i,j) pools plane o*4 + i*2 + j (constant = its id)
+    for o in range(O):
+        for i in range(ph):
+            for j in range(pw):
+                assert out[0, o, i, j] == o * 4 + i * 2 + j
+
+
+def test_anchor_generator_and_density_prior_box_run():
+    def build():
+        f = L.data(name="f", shape=[8, 4, 4], dtype="float32")
+        img = L.data(name="img", shape=[3, 64, 64], dtype="float32")
+        a, av = L.anchor_generator(f, anchor_sizes=[64.0],
+                                   aspect_ratios=[1.0], stride=[16.0, 16.0])
+        b, bv = L.density_prior_box(
+            f, img, densities=[2], fixed_sizes=[32.0], fixed_ratios=[1.0])
+        return [a, b]
+
+    a, b = _run(lambda: build(),
+                {"f": np.zeros((1, 8, 4, 4), np.float32),
+                 "img": np.zeros((1, 3, 64, 64), np.float32)}, n_fetch=2)
+    assert a.shape == (4, 4, 1, 4)
+    # density 2 -> 4 boxes per cell
+    assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+    # reference: x_ctr = 0.5*(16-1) = 7.5, corners +-0.5*(64-1)
+    np.testing.assert_allclose(a[0, 0, 0], [-24.0, -24.0, 39.0, 39.0],
+                               atol=1e-4)
+
+
+def test_generate_proposals_runs():
+    rng = np.random.default_rng(2)
+    N, A, H, W = 1, 3, 4, 4
+
+    def build():
+        s = L.data(name="s", shape=[A, H, W], dtype="float32")
+        d = L.data(name="d", shape=[A * 4, H, W], dtype="float32")
+        info = L.data(name="info", shape=[3], dtype="float32")
+        f = L.data(name="f", shape=[8, H, W], dtype="float32")
+        anc, var = L.anchor_generator(f, anchor_sizes=[32.0],
+                                      aspect_ratios=[0.5, 1.0, 2.0],
+                                      stride=[16.0, 16.0])
+        rois, probs = L.generate_proposals(
+            s, d, info, anc, var, pre_nms_top_n=12, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=4.0)
+        return [rois, probs]
+
+    rois, probs = _run(
+        lambda: build(),
+        {"s": rng.standard_normal((N, A, H, W)).astype(np.float32),
+         "d": 0.1 * rng.standard_normal((N, A * 4, H, W)).astype(np.float32),
+         "info": np.array([[64.0, 64.0, 1.0]], np.float32),
+         "f": np.zeros((N, 8, H, W), np.float32)}, n_fetch=2)
+    assert rois.shape[-1] == 4
+    assert np.isfinite(rois).all() and np.isfinite(probs).all()
