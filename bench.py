@@ -10,7 +10,8 @@ and why each config is shaped the way it is.
 
 Model FLOPs use the standard 6*N*T transformer estimate (N = matmul-
 participating params, embeddings excluded) plus attention terms; ResNet-50
-uses 3x the canonical 4.089 GFLOP forward. Peak chip FLOP/s from device kind.
+uses 3x the 8.18 GF forward (2 ops/MAC — the canonical "4.089 GFLOPs" is
+GMACs; see PERF.md r4). Peak chip FLOP/s from device kind.
 """
 from __future__ import annotations
 
@@ -19,6 +20,11 @@ import time
 
 import jax
 import numpy as np
+
+# ResNet-50 @224 forward FLOPs per image at 2 ops/MAC (the canonical
+# 4.089e9 figure counts multiply-add as one op). Single source of truth —
+# the RN50 tools import this (PERF.md r4 'Finding 0').
+RN50_FWD_FLOPS_PER_IMG = 2 * 4.089e9
 
 
 def _peak_flops(device) -> float:
@@ -152,7 +158,13 @@ def bench_resnet(on_tpu: bool, peak: float):
         (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
         assert np.isfinite(float(np.asarray(lv)))
     img_s = batch / dt
-    mfu = (3 * 4.089e9 * img_s) / peak  # fwd 4.089 GF/img @224, train ~3x
+    # FLOP convention fix (r4): the canonical "4.089 GFLOPs" for RN50@224
+    # counts a multiply-add as ONE op (it is 4.089 GMACs — exact per-layer
+    # enumeration in tools/_rn_stagecost.py gives 8.17 GF/img at 2 ops/MAC).
+    # The 197e12 chip peak and the transformer 6N formula both count 2 ops
+    # per MAC, so the model FLOPs must too — r2/r3 reported RN50 MFU at
+    # half its true value (PERF.md r4).
+    mfu = (3 * RN50_FWD_FLOPS_PER_IMG * img_s) / peak  # train ~3x fwd
     return img_s, mfu
 
 

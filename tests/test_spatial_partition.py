@@ -1,0 +1,110 @@
+"""Spatial partitioning of conv models: image H dim sharded over the `sp`
+mesh axis (GSPMD inserts the 3x3 halo exchanges). The oracle is numerical
+equivalence with the unsharded single-device run of the same program —
+same seed, same feed, same loss."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, make_mesh
+from paddle_tpu.parallel.sharding import annotate_sharding
+
+
+def _build(annotate):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            img = L.data(name="img", shape=[3, 16, 16], dtype="float32")
+            label = L.data(name="label", shape=[1], dtype="int64")
+            x = L.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                         act="relu", param_attr=pt.ParamAttr(name="c1w"),
+                         bias_attr=pt.ParamAttr(name="c1b"))
+            x = L.conv2d(x, num_filters=8, filter_size=3, padding=1,
+                         stride=2, act="relu",
+                         param_attr=pt.ParamAttr(name="c2w"),
+                         bias_attr=pt.ParamAttr(name="c2b"))
+            x = L.pool2d(x, pool_type="avg", global_pooling=True)
+            logits = L.fc(x, size=10, param_attr=pt.ParamAttr(name="fcw"),
+                          bias_attr=pt.ParamAttr(name="fcb"))
+            loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+            if annotate:
+                blk = main.global_block
+                annotate_sharding(blk.var("img"),
+                                  (DATA_AXIS, None, SEQ_AXIS, None))
+                annotate_sharding(blk.var("label"), (DATA_AXIS, None))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_spatial_sharded_step_matches_single_device():
+    rng = np.random.default_rng(0)
+    feed = {"img": rng.standard_normal((8, 3, 16, 16)).astype(np.float32),
+            "label": rng.integers(0, 10, (8, 1)).astype(np.int64)}
+
+    def run(sharded):
+        main, startup, loss = _build(annotate=sharded)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()) as sc:
+            exe.run(startup)
+            if sharded:
+                mesh = make_mesh({"dp": 2, "sp": 4})
+                prog = pt.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name, mesh=mesh)
+            else:
+                prog = main
+            losses = []
+            for _ in range(3):
+                (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+            w = np.asarray(sc.find_var("c1w"))
+        return losses, w
+
+    base_losses, base_w = run(sharded=False)
+    sp_losses, sp_w = run(sharded=True)
+    # same program, same seed: the spatially-sharded trajectory must match
+    np.testing.assert_allclose(sp_losses, base_losses, rtol=2e-5)
+    np.testing.assert_allclose(sp_w, base_w, rtol=2e-4, atol=1e-5)
+    assert base_losses[2] < base_losses[0]  # and it actually trains
+
+
+def test_spatial_sharded_resnet_matches_single_device():
+    """Strided convs + batch-norm + global pool under the sp split: the
+    full ResNet-CIFAR train step must match the unsharded run."""
+    from paddle_tpu.models import resnet
+
+    rng = np.random.default_rng(1)
+    feed = {"img": rng.standard_normal((8, 3, 32, 32)).astype(np.float32),
+            "label": rng.integers(0, 10, (8, 1)).astype(np.int64)}
+
+    def run(sharded):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            with pt.unique_name.guard():
+                loss, acc, _ = resnet.resnet_cifar10()
+                if sharded:
+                    blk = main.global_block
+                    annotate_sharding(blk.var("img"),
+                                      (DATA_AXIS, None, SEQ_AXIS, None))
+                    annotate_sharding(blk.var("label"), (DATA_AXIS, None))
+                pt.optimizer.Momentum(learning_rate=0.05,
+                                      momentum=0.9).minimize(loss)
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()) as sc:
+            exe.run(startup)
+            prog = main
+            if sharded:
+                mesh = make_mesh({"dp": 2, "sp": 4})
+                prog = pt.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name, mesh=mesh)
+            losses = [float(np.asarray(exe.run(prog, feed=feed,
+                                               fetch_list=[loss])[0]))
+                      for _ in range(3)]
+        return losses
+
+    base = run(sharded=False)
+    sp = run(sharded=True)
+    # step 1 is bitwise-comparable; later steps accumulate cross-device
+    # reduction-order drift through the BN statistics (fp32 sums in a
+    # different association), amplified by the momentum trajectory
+    np.testing.assert_allclose(sp[0], base[0], rtol=2e-5)
+    np.testing.assert_allclose(sp, base, rtol=2e-2)

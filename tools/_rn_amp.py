@@ -40,7 +40,8 @@ def run(batch, amp, momentum=True):
         (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
         assert np.isfinite(float(np.asarray(lv))), "loss blew up"
     img_s = batch / dt
-    mfu = (3 * 4.089e9 * img_s) / 197e12
+    from bench import RN50_FWD_FLOPS_PER_IMG
+    mfu = (3 * RN50_FWD_FLOPS_PER_IMG * img_s) / 197e12
     print(f"batch={batch} amp={amp}: {dt*1e3:.1f} ms/step, {img_s:.0f} img/s, MFU {mfu*100:.1f}%", flush=True)
 
 

@@ -1,0 +1,158 @@
+"""A/B: Pallas fused bottleneck block vs XLA's fusion of the same region.
+
+The VERDICT-r3-named lever for ResNet-50: fuse 1x1(256-64)+ReLU ->
+3x3(64-64)+ReLU -> 1x1(64-256)+residual+ReLU into ONE kernel so the two
+64-channel intermediates never round-trip HBM (saves ~205 MB/step of
+traffic for an s1-interior block at batch 128). BN is taken in folded
+scale/bias form on BOTH sides so the A/B isolates the conv-chain cost
+(training-BN batch stats would add identical global reductions to both).
+
+Grid: one image per kernel instance (the whole 56x56x256 activation is
+1.6 MB — fits VMEM with all weights and intermediates). The 3x3 runs as 9
+shifted [HW, 64]x[64, 64] matmuls accumulating in fp32.
+
+Run: python tools/_rn_pallas_block.py
+
+MEASURED RESULT (r4, v5e through axon): single-shot (one block per jit,
+~3.8 ms dispatch floor included on both sides) Pallas 4.59 ms vs XLA
+5.15 ms — an apparent 1.12x win. Chained 10-deep inside one jit (the
+realistic in-graph setting, dispatch amortized): XLA 1.29 ms/block
+(43.3 TF/s) vs Pallas 1.59 ms/block (35.1 TF/s) — XLA WINS by 1.23x,
+because it fuses ACROSS block boundaries (block i's add+relu into block
+i+1's 1x1) while pallas_call is an opaque fusion barrier. The r3-named
+"fused conv+BN+ReLU Pallas chain" lever is therefore measured and
+retired: XLA's own fusion already does this better on these shapes.
+"""
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+B, H, W, C, M = 128, 56, 56, 256, 64
+DT = jnp.bfloat16
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+
+
+def kernel(x_ref, w1_ref, w2_ref, w3_ref, o_ref, y1p_ref):
+    x = x_ref[0]                                   # [H, W, C] bf16
+    xm = x.reshape(H * W, C)
+    y1 = jnp.dot(xm, w1_ref[...], preferred_element_type=jnp.float32)
+    y1 = jnp.maximum(y1, 0.0).astype(DT).reshape(H, W, M)
+    # zero-padded copy for the 3x3 halo
+    y1p_ref[...] = jnp.zeros((H + 2, W + 2, M), DT)
+    y1p_ref[1:H + 1, 1:W + 1, :] = y1
+    acc = jnp.zeros((H * W, M), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            sh = y1p_ref[dy:dy + H, dx:dx + W, :].reshape(H * W, M)
+            acc += jnp.dot(sh, w2_ref[dy, dx],
+                           preferred_element_type=jnp.float32)
+    y2 = jnp.maximum(acc, 0.0).astype(DT)
+    y3 = jnp.dot(y2, w3_ref[...], preferred_element_type=jnp.float32)
+    out = jnp.maximum(y3.reshape(H, W, C) + x.astype(jnp.float32), 0.0)
+    o_ref[0] = out.astype(DT)
+
+
+@jax.jit
+def pallas_block(x, w1, w2, w3):
+    return pl.pallas_call(
+        kernel,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, W, C), lambda b: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, H, W, C), lambda b: (b, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, H, W, C), DT),
+        scratch_shapes=[pltpu.VMEM((H + 2, W + 2, M), DT)],
+    )(x, w1, w2, w3)
+
+
+@jax.jit
+def xla_block(x, w1, w2, w3):
+    y1 = jax.lax.conv_general_dilated(
+        x, w1.reshape(1, 1, C, M), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y1 = jax.nn.relu(y1)
+    y2 = jax.lax.conv_general_dilated(
+        y1, w2.reshape(3, 3, M, M), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    y2 = jax.nn.relu(y2)
+    y3 = jax.lax.conv_general_dilated(
+        y2, w3.reshape(1, 1, M, C), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return jax.nn.relu(y3 + x.astype(y3.dtype)).astype(DT)
+
+
+def timeit(fn, args, n=30):
+    out = fn(*args)
+    np.asarray(_drain(out))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    np.asarray(_drain(out))
+    return (time.perf_counter() - t0) / n
+
+
+K_CHAIN = 10
+
+
+@jax.jit
+def xla_chain(x, w1, w2, w3):
+    c = x
+    for _ in range(K_CHAIN):
+        c = xla_block(c, w1, w2, w3)
+    return c
+
+
+@jax.jit
+def pallas_chain(x, w1, w2, w3):
+    c = x
+    for _ in range(K_CHAIN):
+        c = pallas_block(c, w1, w2, w3)
+    return c
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, H, W, C), dtype=np.float32) * .01,
+                    DT)
+    w1 = jnp.asarray(rng.standard_normal((C, M), dtype=np.float32) * .02, DT)
+    w2 = jnp.asarray(rng.standard_normal((3, 3, M, M), dtype=np.float32) * .02,
+                     DT)
+    w3 = jnp.asarray(rng.standard_normal((M, C), dtype=np.float32) * .02, DT)
+
+    ref = np.asarray(xla_block(x, w1, w2, w3), np.float32)
+    got = np.asarray(pallas_block(x, w1, w2, w3), np.float32)
+    err = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-6)
+    print(f"max rel err pallas vs xla: {err:.4f}")
+
+    fl = 2 * B * H * W * (C * M + 9 * M * M + M * C)
+    t_x = timeit(xla_block, (x, w1, w2, w3))
+    t_p = timeit(pallas_block, (x, w1, w2, w3))
+    print(f"single-shot (incl ~3.8 ms dispatch floor on both):")
+    print(f"  XLA   : {t_x*1e3:.3f} ms  ({fl/t_x/1e12:.1f} TF/s)")
+    print(f"  Pallas: {t_p*1e3:.3f} ms  ({fl/t_p/1e12:.1f} TF/s)")
+
+    # the decisive measurement: chained in one jit, dispatch amortized —
+    # this is what the block costs INSIDE a model graph
+    t_xc = timeit(xla_chain, (x, w1, w2, w3), n=20) / K_CHAIN
+    t_pc = timeit(pallas_chain, (x, w1, w2, w3), n=20) / K_CHAIN
+    print(f"chained x{K_CHAIN} (in-graph):")
+    print(f"  XLA   : {t_xc*1e3:.3f} ms/block  ({fl/t_xc/1e12:.1f} TF/s)")
+    print(f"  Pallas: {t_pc*1e3:.3f} ms/block  ({fl/t_pc/1e12:.1f} TF/s)")
+    print(f"  XLA advantage: {t_pc/t_xc:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

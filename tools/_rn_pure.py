@@ -125,7 +125,8 @@ def main():
     np.asarray(_drain(P["fc"][1]))
     dt = (time.perf_counter() - t0) / N
     img_s = BATCH / dt
-    mfu = 3 * 4.089e9 * img_s / 197e12
+    from bench import RN50_FWD_FLOPS_PER_IMG
+    mfu = 3 * RN50_FWD_FLOPS_PER_IMG * img_s / 197e12
     print(f"pure-jax RN50 {LAYOUT} {DT.__name__} batch={BATCH}: {dt*1e3:.1f} ms/step, "
           f"{img_s:.0f} img/s, MFU {mfu*100:.1f}%", flush=True)
 

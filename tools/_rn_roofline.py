@@ -1,0 +1,186 @@
+"""Per-stage ResNet-50 roofline: measured vs predicted, on the real chip.
+
+For every distinct conv shape in RN50 (batch 128, 224x224) this measures
+the sustained per-conv time inside one jit (scan-chained with a real data
+dependency so XLA can neither CSE nor slice-propagate — the r3
+tools/_conv_inner.py methodology), and compares it against the analytic
+roofline max(FLOPs/peak_matmul, bytes/peak_bw) where both peaks are
+MEASURED first on the same chip (tools/_peak.py and tools/_hbm_bw.py
+patterns). Summing count-weighted times (x3 for fwd+bwd) plus the BN/ReLU/
+residual elementwise traffic predicts the full train step; comparing that
+with the bench-measured step answers whether 14.8% MFU is a dispatch
+problem or the model's arithmetic-intensity ceiling — the committed
+per-stage roofline table VERDICT r3 asked for.
+
+Run: python tools/_rn_roofline.py   (prints a markdown table)
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B = 128
+DT = jnp.bfloat16
+
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+
+
+def drain(x):
+    return np.asarray(_drain(x))
+
+
+# (name, Cin, Cout, k, stride, in_hw, count_in_model)
+CONVS = [
+    ("stem 7x7/2 3-64", 3, 64, 7, 2, 224, 1),
+    ("s1 1x1 64-64", 64, 64, 1, 1, 56, 1),
+    ("s1 3x3 64-64", 64, 64, 3, 1, 56, 3),
+    ("s1 1x1 64-256", 64, 256, 1, 1, 56, 3),
+    ("s1 1x1 256-64", 256, 64, 1, 1, 56, 2),
+    ("s1 down 1x1 64-256", 64, 256, 1, 1, 56, 1),
+    ("s2 1x1 256-128", 256, 128, 1, 1, 56, 1),
+    ("s2 3x3/2 128", 128, 128, 3, 2, 56, 1),
+    ("s2 1x1 128-512", 128, 512, 1, 1, 28, 4),
+    ("s2 down 1x1 256-512/2", 256, 512, 1, 2, 56, 1),
+    ("s2 1x1 512-128", 512, 128, 1, 1, 28, 3),
+    ("s2 3x3 128", 128, 128, 3, 1, 28, 3),
+    ("s3 1x1 512-256", 512, 256, 1, 1, 28, 1),
+    ("s3 3x3/2 256", 256, 256, 3, 2, 28, 1),
+    ("s3 1x1 256-1024", 256, 1024, 1, 1, 14, 6),
+    ("s3 down 1x1 512-1024/2", 512, 1024, 1, 2, 28, 1),
+    ("s3 1x1 1024-256", 1024, 256, 1, 1, 14, 5),
+    ("s3 3x3 256", 256, 256, 3, 1, 14, 5),
+    ("s4 1x1 1024-512", 1024, 512, 1, 1, 14, 1),
+    ("s4 3x3/2 512", 512, 512, 3, 2, 14, 1),
+    ("s4 1x1 512-2048", 512, 2048, 1, 1, 7, 3),
+    ("s4 down 1x1 1024-2048/2", 1024, 2048, 1, 2, 14, 1),
+    ("s4 1x1 2048-512", 2048, 512, 1, 1, 7, 2),
+    ("s4 3x3 512", 512, 512, 3, 1, 7, 2),
+]
+
+K_INNER = 20
+OUTER = 5
+
+
+def measure_matmul_peak():
+    N = 8192
+    a = jnp.full((N, N), 0.5, DT)
+    b = (jnp.eye(N, dtype=jnp.float32)).astype(DT)
+
+    @jax.jit
+    def step(s, b):
+        for _ in range(5):
+            s = s @ b
+        return s
+
+    s = step(a, b)
+    drain(s)
+    t0 = time.perf_counter()
+    s2 = s
+    for _ in range(20):
+        s2 = step(s2, b)
+    drain(s2)
+    dt = (time.perf_counter() - t0) / (20 * 5)
+    return 2 * N ** 3 / dt / 1e12
+
+
+def measure_bw():
+    n = 256 * 1024 * 1024 // 2  # 256 MB bf16
+    x = jnp.full((n,), 0.5, DT)
+
+    @jax.jit
+    def f(x):
+        def body(c, _):
+            return c * jnp.asarray(1.000001, DT), None
+        y, _ = jax.lax.scan(body, x, None, length=K_INNER)
+        return y
+
+    drain(f(x))
+    t0 = time.perf_counter()
+    for _ in range(OUTER):
+        y = f(x)
+    drain(y)
+    dt = (time.perf_counter() - t0) / OUTER / K_INNER
+    return 2 * n * 2 / dt / 1e9  # read+write GB/s
+
+
+def conv_time(cin, cout, k, stride, hw):
+    """Per-conv sustained ms. Same-shape convs chain by direct feedback;
+    shape-changing convs carry the input and couple through a full-output
+    reduction epilogue (forces the whole conv, adds only output-read)."""
+    pad = k // 2
+    x = jnp.full((B, hw, hw, cin), 0.5, DT)
+    w = jnp.full((k, k, cin, cout), 0.001, DT)
+    same = (cin == cout) and stride == 1
+
+    @jax.jit
+    def f(x, w):
+        def body(c, _):
+            y = jax.lax.conv_general_dilated(
+                c, w, (stride, stride), [(pad, pad)] * 2,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if same:
+                return y * jnp.asarray(0.01, DT), None
+            eps = (jnp.mean(y).astype(jnp.float32) * 1e-9).astype(DT)
+            return c * (jnp.asarray(1.0, DT) + eps), None
+
+        y, _ = jax.lax.scan(body, x, None, length=K_INNER)
+        return y
+
+    drain(f(x, w))
+    t0 = time.perf_counter()
+    for _ in range(OUTER):
+        y = f(x, w)
+    drain(y)
+    return (time.perf_counter() - t0) / OUTER / K_INNER
+
+
+def main():
+    matmul_tfs = measure_matmul_peak()
+    bw = measure_bw()
+    print(f"measured peaks: matmul {matmul_tfs:.1f} TF/s, HBM {bw:.0f} GB/s\n")
+    print("| conv | n | ms meas | ms roofline | TF/s | bound | model ms (xN) |")
+    print("|---|---|---|---|---|---|---|")
+    total_fwd = 0.0
+    total_roof = 0.0
+    for name, cin, cout, k, s, hw, n in CONVS:
+        out_hw = hw // s
+        flops = 2 * B * cout * cin * k * k * out_hw * out_hw
+        bytes_ = 2 * (B * cin * hw * hw + cin * cout * k * k
+                      + B * cout * out_hw * out_hw)
+        t = conv_time(cin, cout, k, s, hw)
+        t_f = flops / (matmul_tfs * 1e12)
+        t_b = bytes_ / (bw * 1e9)
+        troof = max(t_f, t_b)
+        bound = "flops" if t_f > t_b else "bw"
+        total_fwd += n * t
+        total_roof += n * troof
+        print(f"| {name} | {n} | {t*1e3:.3f} | {troof*1e3:.3f} | "
+              f"{flops/t/1e12:.1f} | {bound} | {n*t*1e3:.2f} |", flush=True)
+
+    act_elems = (B * 64 * 112 * 112
+                 + 3 * (B * (64 + 64 + 256) * 56 * 56)
+                 + 4 * (B * (128 + 128 + 512) * 28 * 28)
+                 + 6 * (B * (256 + 256 + 1024) * 14 * 14)
+                 + 3 * (B * (512 + 512 + 2048) * 7 * 7))
+    ew_bytes = act_elems * 2 * 3  # ~3 read/write passes (BN, ReLU, residual)
+    ew_time = ew_bytes / (bw * 1e9)
+    print(f"\nconv fwd sum: {total_fwd*1e3:.1f} ms measured, "
+          f"{total_roof*1e3:.1f} ms roofline")
+    print(f"elementwise (BN/ReLU/add) fwd traffic: {ew_bytes/1e9:.2f} GB "
+          f"-> {ew_time*1e3:.1f} ms")
+    train_meas = 3 * (total_fwd + ew_time)
+    train_roof = 3 * (total_roof + ew_time)
+    bench_ms = B / 2383 * 1e3
+    print(f"predicted train step: {train_meas*1e3:.1f} ms from measured "
+          f"convs / {train_roof*1e3:.1f} ms at pure roofline; bench "
+          f"measured {bench_ms:.1f} ms")
+    from bench import RN50_FWD_FLOPS_PER_IMG
+    rn_flops = 3 * RN50_FWD_FLOPS_PER_IMG * B
+    print(f"MFU: bench {rn_flops/(bench_ms/1e3)/197e12:.3f}, "
+          f"measured-conv pred {rn_flops/train_meas/197e12:.3f}, "
+          f"roofline ceiling {rn_flops/train_roof/197e12:.3f}")
+
+
+if __name__ == "__main__":
+    main()

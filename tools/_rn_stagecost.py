@@ -1,0 +1,186 @@
+"""In-situ ResNet-50 stage costs by DIFFERENTIAL measurement.
+
+The scan-chained per-conv microbench has a ~1 ms per-iteration floor (see
+_conv_inner.py results: every small conv reads ~1 ms regardless of FLOPs),
+so isolated timings cannot decompose a 53 ms step. Instead this times the
+full pure-JAX train step of TRUNCATED models (stem only, stem+s0, ...,
+full): successive differences give each stage's fwd+bwd cost inside the
+real fused XLA graph — no dispatch floor, no CSE hazard.
+
+Against each stage's analytic roofline time
+  t_roof = max(FLOPs / measured_matmul_peak, bytes / measured_bw)
+(x3 for train, conv bytes + one BN/ReLU/residual pass) this shows which
+stages sit at their arithmetic-intensity ceiling and what the whole-model
+MFU ceiling is. Run: python tools/_rn_stagecost.py
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B = 128
+DT = jnp.bfloat16
+DN = ("NHWC", "HWIO", "NHWC")
+
+rng = np.random.default_rng(0)
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+
+
+def conv_w(k, ci, co):
+    w = rng.standard_normal((k, k, ci, co), dtype=np.float32) * \
+        np.sqrt(2.0 / (k * k * ci))
+    return jnp.asarray(w, DT)
+
+
+def conv(x, w, s=1):
+    k = w.shape[0]
+    return jax.lax.conv_general_dilated(
+        x, w, (s, s), [(k // 2, k // 2)] * 2, dimension_numbers=DN)
+
+
+def bn(x, p):
+    scale, bias = p
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=(0, 1, 2))
+    v = jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - jnp.square(m)
+    y = (xf - m) / jnp.sqrt(v + 1e-5)
+    return (y * scale + bias).astype(x.dtype)
+
+
+DEPTHS = [3, 4, 6, 3]
+CHANS = [64, 128, 256, 512]
+
+
+def make_params(n_stages):
+    P = {"stem": (conv_w(7, 3, 64), (jnp.ones(64), jnp.zeros(64)))}
+    strides = {}
+    ci = 64
+    for si in range(n_stages):
+        d, c = DEPTHS[si], CHANS[si]
+        for bi in range(d):
+            pre = f"s{si}b{bi}"
+            co = c * 4
+            strides[pre] = 2 if (bi == 0 and si > 0) else 1
+            blk = {
+                "c1": conv_w(1, ci, c), "b1": (jnp.ones(c), jnp.zeros(c)),
+                "c2": conv_w(3, c, c), "b2": (jnp.ones(c), jnp.zeros(c)),
+                "c3": conv_w(1, c, co),
+                "b3": (jnp.ones(co), jnp.zeros(co)),
+            }
+            if ci != co:
+                blk["proj"] = conv_w(1, ci, co)
+                blk["bproj"] = (jnp.ones(co), jnp.zeros(co))
+            P[pre] = blk
+            ci = co
+    return P, strides
+
+
+def forward(P, strides, n_stages, x):
+    x = conv(x, P["stem"][0], 2)
+    x = jax.nn.relu(bn(x, P["stem"][1]))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1),
+                              [(0, 0), (1, 1), (1, 1), (0, 0)])
+    for si in range(n_stages):
+        for bi in range(DEPTHS[si]):
+            blk = P[f"s{si}b{bi}"]
+            s = strides[f"s{si}b{bi}"]
+            idn = x
+            y = jax.nn.relu(bn(conv(x, blk["c1"], 1), blk["b1"]))
+            y = jax.nn.relu(bn(conv(y, blk["c2"], s), blk["b2"]))
+            y = bn(conv(y, blk["c3"], 1), blk["b3"])
+            if "proj" in blk:
+                idn = bn(conv(idn, blk["proj"], s), blk["bproj"])
+            x = jax.nn.relu(y + idn)
+    return jnp.mean(x.astype(jnp.float32))
+
+
+def timed_step(n_stages, x):
+    P, strides = make_params(n_stages)
+
+    @jax.jit
+    def step(P, x):
+        loss, g = jax.value_and_grad(
+            lambda p: forward(p, strides, n_stages, x))(P)
+        P = jax.tree.map(lambda p, gg: p - 0.1 * gg.astype(p.dtype), P, g)
+        return P, loss
+
+    P, loss = step(P, x)
+    np.asarray(_drain(P["stem"][0]))
+    N = 20
+    t0 = time.perf_counter()
+    for _ in range(N):
+        P, loss = step(P, x)
+    np.asarray(_drain(P["stem"][0]))
+    return (time.perf_counter() - t0) / N
+
+
+def stage_roofline(si, matmul_tfs, bw):
+    """Analytic fwd FLOPs and bytes for stage si (convs + one elementwise
+    pass per BN/ReLU/residual tensor)."""
+    d, c = DEPTHS[si], CHANS[si]
+    hw_in = [56, 56, 28, 14][si]
+    hw = [56, 28, 14, 7][si]
+    ci = 64 if si == 0 else CHANS[si - 1] * 4
+    co = c * 4
+    flops = 0
+    bytes_ = 0
+    for bi in range(d):
+        cin = ci if bi == 0 else co
+        h_in = hw_in if bi == 0 else hw
+        # c1 (on the input resolution), c2 (strided to hw), c3
+        trio = [(1, cin, c, h_in, h_in),
+                (3, c, c, h_in if bi == 0 else hw, hw),
+                (1, c, co, hw, hw)]
+        if bi == 0:
+            trio.append((1, cin, co, h_in, hw))  # projection
+        for k, a, b_, hin, hout in trio:
+            flops += 2 * B * a * b_ * k * k * hout * hout
+            bytes_ += 2 * (B * a * hin * hin + a * b_ * k * k
+                           + B * b_ * hout * hout)
+        # elementwise: BN+ReLU on c/c/co maps + residual add
+        ew = B * (c * (hw if bi else h_in) ** 2 + c * hw * hw
+                  + 2 * co * hw * hw)
+        bytes_ += 2 * 2 * ew  # read+write, bf16
+    t = max(flops / (matmul_tfs * 1e12), bytes_ / (bw * 1e9))
+    return flops, bytes_, t
+
+
+def main():
+    from _rn_roofline import measure_matmul_peak, measure_bw
+
+    matmul_tfs = measure_matmul_peak()
+    bw = measure_bw()
+    print(f"measured peaks: matmul {matmul_tfs:.1f} TF/s, HBM {bw:.0f} GB/s")
+
+    x = jnp.asarray(rng.standard_normal((B, 224, 224, 3), dtype=np.float32),
+                    DT)
+    times = []
+    for n in range(5):
+        t = timed_step(n, x)
+        times.append(t)
+        print(f"prefix stem+{n} stages: {t*1e3:.1f} ms/step", flush=True)
+
+    print("\n| stage | in-situ ms (train) | roofline ms (x3) | ratio |")
+    print("|---|---|---|---|")
+    total_roof = times[0]  # stem prefix cost taken as measured
+    for si in range(4):
+        dt = (times[si + 1] - times[si]) * 1e3
+        fl, by, troof = stage_roofline(si, matmul_tfs, bw)
+        print(f"| s{si} ({DEPTHS[si]} blocks) | {dt:.1f} | "
+              f"{3*troof*1e3:.1f} | {dt/(3*troof*1e3):.2f}x |", flush=True)
+        total_roof += 3 * troof
+    print(f"\nfull-model measured: {times[4]*1e3:.1f} ms; "
+          f"roofline total (stem measured + stages at roofline): "
+          f"{total_roof*1e3:.1f} ms")
+    from bench import RN50_FWD_FLOPS_PER_IMG
+    rn = 3 * RN50_FWD_FLOPS_PER_IMG * B
+    print(f"MFU: measured {rn/times[4]/197e12:.3f}, "
+          f"at-roofline ceiling {rn/total_roof/197e12:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,8 @@ def run(batch):
         assert np.isfinite(float(np.asarray(lv)))
     img_s = batch / dt
     # ResNet-50 @224: ~4.09 GFLOP fwd/image; train ~ 3x fwd
-    mfu = (3 * 4.089e9 * img_s) / 197e12
+    from bench import RN50_FWD_FLOPS_PER_IMG
+    mfu = (3 * RN50_FWD_FLOPS_PER_IMG * img_s) / 197e12
     print(json.dumps({"batch": batch, "img_s": round(img_s, 1), "mfu": round(mfu, 4)}))
 
 if __name__ == "__main__":
