@@ -29,7 +29,7 @@ import numpy as np
 
 from .. import flags
 
-__all__ = ["Communicator"]
+__all__ = ["Communicator", "GeoCommunicator"]
 
 
 class Communicator:
@@ -235,3 +235,52 @@ class Communicator:
             # e.g. a wrong name in recv_ctx) propagates: swallowing it would
             # silently train the whole run on initial parameters
             self.scope.set_var(pname, val)
+
+
+class GeoCommunicator:
+    """Geo-SGD communication (reference GeoSgdCommunicator,
+    communicator.h:190): the trainer optimizes LOCALLY; every
+    `push_nums` steps it pushes the accumulated parameter delta
+    (local_param - param_at_last_sync) to the servers — which simply ADD
+    it — then pulls the fresh global param and rebases. Staleness trades
+    for a push_nums-fold reduction in communication rounds.
+
+    param_ctx: {param_name: {"epmap": [...], "sections": [...]}} — note
+    PARAMS, not grads: geo mode ships parameter deltas, never gradients.
+    """
+
+    def __init__(self, param_ctx: dict, client, scope,
+                 push_nums: int = 100):
+        self.param_ctx = param_ctx
+        self.client = client
+        self.scope = scope
+        self.push_nums = max(int(push_nums), 1)
+        self._base: dict[str, np.ndarray] = {}
+        self._steps = 0
+
+    def start(self):
+        for name in self.param_ctx:
+            v = self.scope.find_var(name)
+            if v is None:
+                raise RuntimeError(f"GeoCommunicator: scope missing '{name}'")
+            self._base[name] = np.asarray(v, dtype=np.float32).copy()
+
+    def mark_step(self):
+        """Call once per local optimizer step; pushes + rebases on the
+        push_nums boundary."""
+        self._steps += 1
+        if self._steps % self.push_nums == 0:
+            self.push_and_pull()
+
+    def push_and_pull(self):
+        from .ps_rpc import fetch_sections, send_delta_sections
+
+        for name, ctx in self.param_ctx.items():
+            local = np.asarray(self.scope.find_var(name), dtype=np.float32)
+            delta = local - self._base[name]
+            send_delta_sections(self.client, name, delta,
+                                ctx["epmap"], ctx.get("sections") or [])
+            fresh = fetch_sections(self.client, name,
+                                   ctx["epmap"], ctx.get("sections") or [])
+            self.scope.set_var(name, fresh.astype(local.dtype))
+            self._base[name] = np.asarray(fresh, dtype=np.float32).copy()

@@ -45,16 +45,22 @@ def _parse_ep(ep: str):
 # between the three users (reference parameter_send.cc / parameter_recv.cc).
 
 
-def send_sections(client, name: str, arr, epmap, sections) -> None:
-    """Send a dense var, row-split per `sections` across `epmap`. EMPTY
-    sections = unsliced whole var under its bare name; NON-empty (even a
-    single block) = the server registered "name.block{j}" wire names."""
+def iter_sections(name: str, arr, epmap, sections):
+    """The one definition of the row-split wire protocol: yields
+    (endpoint, wire_name, row_slice). EMPTY sections = unsliced whole var
+    under its bare name; NON-empty (even a single block) = the server
+    registered "name.block{j}" wire names."""
     if not sections:
-        client.send_var(epmap[0], name, arr)
+        yield epmap[0], name, arr
         return
     offs = np.cumsum([0] + list(sections[:-1]))
     for j, (ep, off, rows) in enumerate(zip(epmap, offs, sections)):
-        client.send_var(ep, f"{name}.block{j}", arr[off:off + rows])
+        yield ep, f"{name}.block{j}", arr[off:off + rows]
+
+
+def send_sections(client, name: str, arr, epmap, sections) -> None:
+    for ep, wire, part in iter_sections(name, arr, epmap, sections):
+        client.send_var(ep, wire, part)
 
 
 def fetch_sections(client, name: str, epmap, sections) -> np.ndarray:
@@ -152,7 +158,8 @@ class PSClient:
                         "trainer": self.trainer_id, "value": payload})
 
     def get_var(self, ep: str, name: str) -> np.ndarray:
-        return self._call(ep, {"op": "get", "name": name})
+        return self._call(ep, {"op": "get", "name": name,
+                               "trainer": self.trainer_id})
 
     def prefetch(self, ep: str, name: str, ids) -> np.ndarray:
         """Fetch only the given (slice-local) rows of a server-resident
@@ -199,12 +206,18 @@ class PServerRuntime:
     trainer sends `complete`."""
 
     def __init__(self, endpoint: str, n_trainers: int, sync_mode: bool,
-                 blocks: list[dict], scope, executor):
+                 blocks: list[dict], scope, executor,
+                 dc_asgd: bool = False, dc_asgd_lambda: float = 1.0):
         """blocks: [{grad, param, optimize_program, sparse,
                      origin_param?, begin?, rows?}]"""
         self.endpoint = endpoint
         self.n_trainers = n_trainers
         self.sync_mode = sync_mode
+        # delay-compensated async SGD (reference _append_dc_asgd_ops):
+        # per-(grad, trainer) parameter snapshots for the compensation term
+        self.dc_asgd = dc_asgd and not sync_mode
+        self.dc_lambda = float(dc_asgd_lambda)
+        self._param_bak: dict[tuple[str, int], np.ndarray] = {}
         self.blocks = {b["grad"]: b for b in blocks}
         self.scope = scope
         self.exe = executor
@@ -222,6 +235,8 @@ class PServerRuntime:
                 begin = int(b.get("begin", 0))
                 scope.set_var(b["param"],
                               np.asarray(full)[begin:begin + rows].copy())
+        # delta payloads (geo-SGD) arrive under the PARAM wire name
+        self._param_blocks = {b["param"]: b for b in blocks}
         self._lock = threading.Lock()
         self._grad_buf: dict[str, dict[int, Any]] = {}
         self._barrier_waiting: list = []
@@ -253,7 +268,8 @@ class PServerRuntime:
         """Async mode: apply immediately with whatever arrived."""
         buf = self._grad_buf.get(grad_name, {})
         for tid in list(buf):
-            self._apply_update(grad_name, [buf.pop(tid)], scale=1.0)
+            self._apply_update(grad_name, [buf.pop(tid)], scale=1.0,
+                               trainer=tid)
 
     def _handle_barrier(self, msg, conn):
         with self._lock:
@@ -290,12 +306,26 @@ class PServerRuntime:
             self._grad_buf[grad_name] = {}
         self._step += 1
 
-    def _apply_update(self, grad_name, payloads, scale: float):
+    def _apply_update(self, grad_name, payloads, scale: float, trainer=None):
         from ..core.selected_rows import SelectedRows
 
+        if payloads[0][0] == "delta":
+            # geo-SGD payload: arrives under the PARAM wire name; the
+            # server just ADDS it (reference GeoSgdCommunicator server
+            # contract), no optimize program
+            spec = self._param_blocks.get(grad_name)
+            if spec is None:
+                return
+            param = np.asarray(self.scope.find_var(spec["param"]),
+                               dtype=np.float32)
+            for p in payloads:
+                param = param + np.asarray(p[1], np.float32)
+            self.scope.set_var(spec["param"], param)
+            return
         spec = self.blocks.get(grad_name)
         if spec is None:
             return
+
         if payloads[0][0] == "sparse":
             rows = np.concatenate([p[1] for p in payloads])
             vals = np.concatenate([p[2] for p in payloads]) * scale
@@ -305,6 +335,15 @@ class PServerRuntime:
             for p in payloads[1:]:
                 acc += p[1]
             grad = acc * scale
+            if self.dc_asgd and trainer is not None:
+                # reference _append_dc_asgd_ops: g_comp = g + lambda *
+                # g*g*(param_now - param_bak[trainer]); the snapshot then
+                # advances to the freshly updated param
+                param = np.asarray(self.scope.find_var(spec["param"]),
+                                   dtype=np.float32)
+                bak = self._param_bak.get((grad_name, trainer))
+                if bak is not None:
+                    grad = grad + self.dc_lambda * grad * grad * (param - bak)
         from ..executor import scope_guard
 
         with scope_guard(self.scope):
@@ -336,9 +375,19 @@ class PServerRuntime:
     def _handle_get(self, msg):
         with self._lock:
             v = self.scope.find_var(msg["name"])
-        if v is None:
-            raise KeyError(f"pserver has no var '{msg['name']}'")
-        return np.asarray(v)
+            if v is None:
+                raise KeyError(f"pserver has no var '{msg['name']}'")
+            out = np.asarray(v)
+            if self.dc_asgd and "trainer" in msg:
+                # DC-ASGD snapshots the param AT THE MOMENT THE TRAINER
+                # SEES IT — compensation then measures exactly the updates
+                # that trainer missed (snapshotting at apply time instead
+                # would also count updates it had already observed)
+                for spec in self.blocks.values():
+                    if spec["param"] == msg["name"]:
+                        self._param_bak[(spec["grad"], msg["trainer"])] = \
+                            out.astype(np.float32).copy()
+        return out
 
     def _handle_prefetch(self, msg):
         """Row-gather from a table slice (reference
@@ -476,3 +525,13 @@ class PServerRuntime:
                     conn.send(("err", f"{type(e).__name__}: {e}"))
                 except Exception:
                     return
+
+
+def send_delta_sections(client, name: str, delta, epmap, sections) -> None:
+    """Geo-SGD push: ship an accumulated parameter DELTA under the PARAM
+    wire name (server adds it, no optimizer). Shares iter_sections so the
+    slicing math cannot drift from send_sections."""
+    for ep, wire, part in iter_sections(name, delta, epmap, sections):
+        client._call(ep, {"op": "send", "name": wire,
+                          "trainer": client.trainer_id,
+                          "value": ("delta", np.asarray(part))})

@@ -109,6 +109,8 @@ def listen_and_serv(ctx: ExecContext):
         blocks=blocks,
         scope=global_scope(),
         executor=Executor(),
+        dc_asgd=bool(ctx.attr("dc_asgd", False)),
+        dc_asgd_lambda=float(ctx.attr("dc_asgd_lambda", 1.0)),
     )
     rt.serve()
     return {}

@@ -50,6 +50,18 @@ class DistributeTranspilerConfig:
     # False = sends apply immediately server-side, Communicator merges +
     # recv-threads client-side, no barriers
     sync_mode = True
+    # Delay-compensated async SGD (reference distribute_transpiler.py:1979
+    # _append_dc_asgd_ops): in async mode the server compensates each
+    # trainer's stale gradient with lambda * g * g * (param_now -
+    # param_seen_by_that_trainer) before applying it, then snapshots the
+    # fresh param for that trainer. Only meaningful with sync_mode=False.
+    dc_asgd = False
+    dc_asgd_lambda = 1.0
+    # Geo-SGD (reference GeoSgdCommunicator): trainers optimize LOCALLY and
+    # push accumulated parameter DELTAS every geo_sgd_need_push_nums steps;
+    # the server adds deltas (no server-side optimizer).
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
 
 
 class VarBlock:
@@ -281,6 +293,9 @@ class DistributeTranspiler:
                 "Fanin": self.n_trainers,
                 "sync_mode": self.sync_mode,
                 "block_specs": self._ep_specs[endpoint],
+                "dc_asgd": bool(getattr(self.config, "dc_asgd", False)),
+                "dc_asgd_lambda": float(
+                    getattr(self.config, "dc_asgd_lambda", 1.0)),
             },
         )
         return prog
@@ -303,6 +318,12 @@ class DistributeTranspiler:
         # the distributed tables' init ops, so stash a deep copy first
         self._pserver_startup = Program.from_dict(self.startup_program.to_dict())
         block = self.origin_program.global_block
+        if getattr(self.config, "geo_sgd_mode", False):
+            # geo-SGD: the trainer optimizes LOCALLY — the optimizer ops
+            # STAY, no grads are sent, no recv ops exist. Parameter deltas
+            # travel through the GeoCommunicator (get_geo_communicator)
+            # every geo_sgd_need_push_nums steps instead.
+            return
         opt_set = set(id(op) for op in self._opt_ops)
         block.ops = [op for op in block.ops if id(op) not in opt_set]
         if self.dist_tables:
@@ -410,6 +431,28 @@ class DistributeTranspiler:
 
     def get_trainer_program(self, wait_port=True) -> Program:
         return self.origin_program
+
+    def get_geo_communicator(self, scope, client=None):
+        """Geo-SGD mode: build the GeoCommunicator over every dense param
+        (reference GeoSgdCommunicator). Call mark_step() once per local
+        train step; pushes/rebases every config.geo_sgd_need_push_nums."""
+        if not getattr(self.config, "geo_sgd_mode", False):
+            raise RuntimeError("get_geo_communicator requires "
+                               "config.geo_sgd_mode = True")
+        from ..distributed.communicator import GeoCommunicator
+        from ..distributed.ps_rpc import PSClient
+
+        param_ctx = {}
+        for pb in self.param_blocks:
+            if pb.get("dist_table") or pb["sparse"]:
+                continue  # geo ships dense param deltas only
+            param_ctx[pb["param"]] = {"epmap": pb["eps"],
+                                      "sections": pb["sections"]}
+        client = client or PSClient.get(self.eps, self.trainer_id)
+        return GeoCommunicator(
+            param_ctx, client, scope,
+            push_nums=int(getattr(self.config,
+                                  "geo_sgd_need_push_nums", 100)))
 
     def get_communicator_context(self):
         """(send_ctx, recv_ctx) for the async Communicator: per-gradient and

@@ -1,0 +1,195 @@
+"""DC-ASGD delay compensation + geo-SGD delta protocol.
+
+DC-ASGD (reference distribute_transpiler.py:1979 _append_dc_asgd_ops): on a
+staleness-heavy run, async+DC must track the sync-SGD oracle closer than
+plain async. The server object is exercised directly (no sockets) — the
+trajectory is the contract, the wire is covered by the e2e dist tests.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+
+def _make_server(dc_asgd, lam=1.0, lr=0.1):
+    """PServerRuntime over one param 'w' with an SGD optimize program."""
+    from paddle_tpu.distributed.ps_rpc import PServerRuntime
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            g = L.data(name="w@GRAD", shape=[4], dtype="float32",
+                       append_batch_size=False)
+            w = main.global_block.create_parameter(
+                shape=[4], dtype="float32", name="w")
+            lrv = L.tensor.fill_constant([1], "float32", lr)
+            main.global_block.append_op(
+                "sgd",
+                {"Param": ["w"], "Grad": ["w@GRAD"],
+                 "LearningRate": [lrv.name]},
+                {"ParamOut": ["w"]}, {})
+    scope = pt.Scope()
+    scope.set_var("w", np.zeros(4, np.float32))
+    rt = PServerRuntime(
+        endpoint="test:0", n_trainers=2, sync_mode=False,
+        blocks=[{"grad": "w@GRAD", "param": "w", "origin_param": "w",
+                 "sparse": False, "optimize_program": main}],
+        scope=scope, executor=pt.Executor(),
+        dc_asgd=dc_asgd, dc_asgd_lambda=lam)
+    return rt, scope
+
+
+def _simulate(rt, scope, w_star, steps=30, delay=4, seed=0):
+    """Trainer 0 sends fresh grads every step; trainer 1 computes its grad
+    at the param it saw `delay` steps ago (the staleness injector).
+    Quadratic loss: grad(w) = w - w_star."""
+    rng = np.random.default_rng(seed)
+    history = [np.asarray(scope.find_var("w"), np.float32).copy()]
+    slow_job = None  # (grad, finish_step) — the slow trainer's in-flight work
+    for t in range(steps):
+        # trainer 0: pull -> compute -> send within the step (fresh grads)
+        w_now = np.asarray(rt._handle_get({"name": "w", "trainer": 0}),
+                           np.float32).copy()
+        noise = rng.standard_normal(4).astype(np.float32) * 0.05
+        rt._handle_send({"name": "w@GRAD", "trainer": 0,
+                         "value": ("dense", w_now - w_star + noise)})
+        # trainer 1: pulls only when it STARTS a computation; the result
+        # lands `delay` steps later — the real slow-trainer pattern (it does
+        # not pull mid-computation, so the server's get-time snapshot is
+        # exactly the params this gradient was computed at)
+        if slow_job is None:
+            w_seen = np.asarray(rt._handle_get({"name": "w", "trainer": 1}),
+                                np.float32).copy()
+            slow_job = (w_seen - w_star + noise, t + delay)
+        elif t >= slow_job[1]:
+            rt._handle_send({"name": "w@GRAD", "trainer": 1,
+                             "value": ("dense", slow_job[0])})
+            slow_job = None
+        history.append(np.asarray(scope.find_var("w"), np.float32).copy())
+    return np.stack(history)
+
+
+def _sync_oracle(w_star, steps=30, lr=0.1, seed=0):
+    """Two-trainer synchronous SGD, both grads fresh, averaged."""
+    rng = np.random.default_rng(seed)
+    w = np.zeros(4, np.float32)
+    hist = [w.copy()]
+    for t in range(steps):
+        noise = rng.standard_normal(4).astype(np.float32) * 0.05
+        g = (w - w_star + noise)  # both trainers' fresh grad at w
+        w = w - lr * g
+        hist.append(w.copy())
+    return np.stack(hist)
+
+
+def test_dc_asgd_tracks_sync_oracle_closer_than_plain_async():
+    w_star = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    oracle = _sync_oracle(w_star)
+
+    def final_gap(dc):
+        rt, scope = _make_server(dc_asgd=dc, lam=1.0)
+        traj = _simulate(rt, scope, w_star)
+        # distance of the whole trajectory tail to the oracle trajectory
+        n = min(len(traj), len(oracle))
+        return float(np.linalg.norm(traj[n // 2:n] - oracle[n // 2:n]))
+
+    plain = final_gap(dc=False)
+    dc = final_gap(dc=True)
+    assert dc < plain, (dc, plain)
+
+
+def test_dc_asgd_snapshot_taken_at_get_time():
+    rt, scope = _make_server(dc_asgd=True)
+    w_star = np.ones(4, np.float32)
+    # no get yet -> no snapshot -> first send applies uncompensated
+    rt._handle_send({"name": "w@GRAD", "trainer": 0,
+                     "value": ("dense", -w_star)})
+    assert ("w@GRAD", 0) not in rt._param_bak
+    np.testing.assert_allclose(np.asarray(scope.find_var("w")),
+                               0.1 * w_star, rtol=1e-6)
+    # the snapshot records what the trainer SAW when it pulled
+    seen = rt._handle_get({"name": "w", "trainer": 0})
+    np.testing.assert_allclose(rt._param_bak[("w@GRAD", 0)], seen)
+    # further applies must not move the snapshot (only the next get does)
+    rt._handle_send({"name": "w@GRAD", "trainer": 1,
+                     "value": ("dense", -w_star)})
+    np.testing.assert_allclose(rt._param_bak[("w@GRAD", 0)], seen)
+
+
+def test_geo_delta_payload_adds_to_param():
+    rt, scope = _make_server(dc_asgd=False)
+    rt._handle_send({"name": "w", "trainer": 0,
+                     "value": ("delta", np.full(4, 0.25, np.float32))})
+    np.testing.assert_allclose(np.asarray(scope.find_var("w")),
+                               0.25, rtol=1e-6)
+
+
+def test_geo_communicator_push_pull_cycle():
+    """GeoCommunicator against a fake client backed by a dict 'server':
+    local steps accumulate, push ships the delta, pull rebases."""
+    from paddle_tpu.distributed.communicator import GeoCommunicator
+
+    server = {"w": np.zeros(4, np.float32)}
+
+    class FakeClient:
+        trainer_id = 0
+
+        def _call(self, ep, msg):
+            if msg["op"] == "send":
+                kind, delta = msg["value"]
+                assert kind == "delta"
+                server["w"] = server["w"] + delta
+                return True
+            raise AssertionError(msg)
+
+        def get_var(self, ep, name):
+            return server["w"].copy()
+
+    scope = pt.Scope()
+    scope.set_var("w", np.zeros(4, np.float32))
+    geo = GeoCommunicator({"w": {"epmap": ["ep0"], "sections": []}},
+                          FakeClient(), scope, push_nums=3)
+    geo.start()
+    for step in range(6):
+        local = np.asarray(scope.find_var("w"), np.float32)
+        scope.set_var("w", local + 0.1)  # a "local optimizer step"
+        geo.mark_step()
+    # two pushes of +0.3 each; server also reflected back into the scope
+    np.testing.assert_allclose(server["w"], 0.6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(scope.find_var("w")), 0.6,
+                               rtol=1e-5)
+
+
+def test_geo_mode_transpile_keeps_local_optimizer():
+    """config.geo_sgd_mode: trainer program keeps its optimizer ops and
+    sends NO gradients; get_geo_communicator covers every dense param."""
+    from paddle_tpu.transpiler import (DistributeTranspiler,
+                                       DistributeTranspilerConfig)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[4], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+            pt.optimizer.SGD(0.1).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.geo_sgd_mode = True
+    cfg.geo_sgd_need_push_nums = 7
+    t = DistributeTranspiler(config=cfg)
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:60901", trainers=2)
+    trainer = t.get_trainer_program()
+    ops = [op.type for op in trainer.global_block.ops]
+    assert "sgd" in ops, ops           # local optimizer retained
+    assert "send" not in ops, ops      # no gradient sends
+    scope = pt.Scope()
+
+    class NullClient:
+        trainer_id = 0
+
+    geo = t.get_geo_communicator(scope, client=NullClient())
+    assert geo.push_nums == 7
+    assert len(geo.param_ctx) >= 2     # fc weight + bias

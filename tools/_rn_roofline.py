@@ -14,8 +14,10 @@ per-stage roofline table VERDICT r3 asked for.
 
 Run: python tools/_rn_roofline.py   (prints a markdown table)
 """
+import sys
 import time
 
+sys.path.insert(0, "/root/repo")
 import jax
 import jax.numpy as jnp
 import numpy as np
