@@ -5,3 +5,4 @@ experiment driver, not a framework capability)."""
 from . import distillation  # noqa: F401
 from . import prune  # noqa: F401
 from . import quantization  # noqa: F401
+from . import nas  # noqa: F401
