@@ -136,3 +136,61 @@ def test_amp_rewrites_control_flow_sub_blocks():
     (lv,) = exe.run(main, feed={"xs": np.ones((T_, B, D), np.float32)},
                     fetch_list=[loss])
     assert np.isfinite(float(lv))
+
+
+def test_amp_cast_hoist_through_layout_ops():
+    """Down-casts below layout-only ops (reshape/transpose) are hoisted above
+    them so data movement happens at low precision — and the hoist must NOT
+    create a second producer of an existing @BF16 var when the same fp32
+    source also feeds a white op directly (r5: double-producer made
+    append_backward sum both cast_grads -> 1.5x gradients)."""
+    x = L.data(name="x", shape=[4, 6], dtype="float32")
+    # shared fp32 intermediate with a learnable producer: its (possibly
+    # corrupted) grad propagates into shared's weight grad, which we fetch
+    z = L.fc(x, size=24, act="relu", name="shared")
+    a = L.fc(z, size=3)                     # white op consumes z directly
+    r = L.reshape(z, [-1, 4, 6])            # layout chain then white op
+    r = L.transpose(r, [0, 2, 1])
+    b = L.fc(r, size=3)
+    loss = L.mean(a) + L.mean(b)
+    main = pt.default_main_program()
+    amp.rewrite_program(main, amp.AutoMixedPrecisionLists(), "bfloat16")
+    block = main.global_block
+    # every var has at most one producer
+    producers = {}
+    for op in block.ops:
+        for n in op.output_names:
+            assert n not in producers, f"two producers for {n}: " \
+                f"{producers[n].type} and {op.type}"
+            producers[n] = op
+    # the reshape now consumes a bf16 view, not fp32
+    (reshape_op,) = [op for op in block.ops if op.type == "reshape2"]
+    (rin,) = reshape_op.input("X")
+    assert "bf16" in str(block.var(rin).dtype.value).replace("loat", ""), rin
+    pt.backward.append_backward(loss)
+    w_shared = main.all_parameters()[0].name
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.standard_normal((2, 4, 6)).astype(np.float32)}
+    params = [np.array(pt.global_scope().find_var(p.name))
+              for p in main.all_parameters()]
+    (gw,) = exe.run(main, feed=feed, fetch_list=[w_shared + "@GRAD"])
+    # fp32 oracle built fresh with the same params
+    with pt.program_guard(pt.Program(), pt.Program()):
+        x2 = L.data(name="x", shape=[4, 6], dtype="float32")
+        z2 = L.fc(x2, size=24, act="relu", name="shared")
+        a2 = L.fc(z2, size=3)
+        r2 = L.reshape(z2, [-1, 4, 6])
+        r2 = L.transpose(r2, [0, 2, 1])
+        b2 = L.fc(r2, size=3)
+        loss2 = L.mean(a2) + L.mean(b2)
+        main2 = pt.default_main_program()
+        pt.backward.append_backward(loss2)
+        w2 = main2.all_parameters()[0].name
+        exe.run(pt.default_startup_program())
+        for p2, val in zip(main2.all_parameters(), params):
+            pt.global_scope().set_var(p2.name, val)
+        (gw_ref,) = exe.run(main2, feed=feed, fetch_list=[w2 + "@GRAD"])
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                               rtol=3e-2, atol=3e-2)

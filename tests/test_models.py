@@ -57,6 +57,105 @@ def test_resnet_cifar_forward_backward():
     assert float(l1) < float(l0)
 
 
+def test_resnet_s2d_stem_fold_equivalence():
+    """The space-to-depth stem (s2d_stem=True) is an exact refactoring of
+    the 7x7-s2 stem: fold_stem_to_s2d maps trained 7x7 weights onto the
+    4x4 s2d kernel with identical outputs (models/resnet.py)."""
+    from paddle_tpu import layers as L
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+
+    guard, main_a, startup_a = _fresh_programs()
+    with guard:
+        img = L.data(name="img", shape=[3, 32, 32], dtype="float32")
+        out_a = L.conv2d(img, num_filters=8, filter_size=7, stride=2,
+                         padding=3, bias_attr=False, name="stem")
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup_a)
+        w_name = main_a.all_parameters()[0].name
+        w7 = np.array(pt.global_scope().find_var(w_name))
+        (ref,) = exe.run(main_a, feed={"img": x}, fetch_list=[out_a])
+
+    guard, main_b, startup_b = _fresh_programs()
+    with guard:
+        img = L.data(name="img", shape=[3, 32, 32], dtype="float32")
+        y = L.space_to_depth(img, blocksize=2)
+        out_b = L.conv2d(y, num_filters=8, filter_size=4, stride=1,
+                         padding=[2, 1, 2, 1], bias_attr=False, name="stem")
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup_b)
+        w_name = main_b.all_parameters()[0].name
+        pt.global_scope().set_var(w_name, resnet.fold_stem_to_s2d(w7))
+        (got,) = exe.run(main_b, feed={"img": x}, fetch_list=[out_b])
+
+    assert ref.shape == got.shape, (ref.shape, got.shape)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" is a pure layout change: same params (weights
+    stay OIHW), same function. Run one small trunk both ways with shared
+    initial weights and compare logits."""
+    from paddle_tpu import layers as L
+
+    rng = np.random.default_rng(7)
+    x_nchw = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+    x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
+    exe = pt.Executor()
+    outs, params = {}, {}
+    for fmt in ("NCHW", "NHWC"):
+        guard, main, startup = _fresh_programs()
+        with guard:
+            shape = [3, 32, 32] if fmt == "NCHW" else [32, 32, 3]
+            img = L.data(name="img", shape=shape, dtype="float32")
+            logits = resnet.resnet(img, depth=18, num_classes=5,
+                                   data_format=fmt)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            if fmt == "NCHW":
+                params = [np.array(pt.global_scope().find_var(p.name))
+                          for p in main.all_parameters()]
+            else:
+                # same builder order both times; names differ only by
+                # unique_name suffixes, so map positionally. NHWC conv
+                # weights are stored HWIO (layers/nn.py conv2d) — transpose
+                # the NCHW-run OIHW values to match.
+                for p, val in zip(main.all_parameters(), params):
+                    want = tuple(pt.global_scope().find_var(p.name).shape)
+                    if want != tuple(val.shape):
+                        val = val.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+                    assert want == tuple(val.shape), p.name
+                    pt.global_scope().set_var(p.name, val)
+            (outs[fmt],) = exe.run(
+                main, feed={"img": x_nchw if fmt == "NCHW" else x_nhwc},
+                fetch_list=[logits])
+    np.testing.assert_allclose(outs["NHWC"], outs["NCHW"],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_resnet50_s2d_stem_trains():
+    guard, main, startup = _fresh_programs()
+    with guard:
+        img = pt.layers.data(name="img", shape=[3, 64, 64], dtype="float32")
+        label = pt.layers.data(name="label", shape=[1], dtype="int64")
+        loss, acc, _ = resnet.resnet50(img, label, num_classes=10,
+                                       s2d_stem=True)
+        pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        rng = np.random.default_rng(1)
+        feed = {
+            "img": rng.standard_normal((4, 3, 64, 64)).astype(np.float32),
+            "label": rng.integers(0, 10, (4, 1)).astype(np.int64),
+        }
+        l0 = exe.run(main, feed=feed, fetch_list=[loss])[0]
+        (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(l0) and np.isfinite(l1)
+
+
 def test_deepfm_trains_with_sparse_grads():
     guard, main, startup = _fresh_programs()
     with guard:

@@ -1,0 +1,135 @@
+"""What is ResNet s0/s1 time actually spent on? Differential decomposition.
+
+Times truncated prefixes (stem vs stem+stage) with the stage's
+elementwise chain varied:
+  bn        — full batch norm (batch stats) + relu + residual  [production]
+  scalebias — y*scale+bias + relu + residual (no batch statistics)
+  convonly  — convs + residual add only
+If bn >> scalebias: the BN statistic reductions (extra HBM passes) bind.
+If scalebias ~~ convonly >> roofline: the convs themselves bind (MXU fill).
+Run: python tools/_rn_diag.py [stage_index]
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B = 128
+DT = jnp.bfloat16
+DN = ("NHWC", "HWIO", "NHWC")
+SI = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+
+rng = np.random.default_rng(0)
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+
+DEPTHS = [3, 4, 6, 3]
+CHANS = [64, 128, 256, 512]
+
+
+def conv_w(k, ci, co):
+    w = rng.standard_normal((k, k, ci, co), dtype=np.float32) * \
+        np.sqrt(2.0 / (k * k * ci))
+    return jnp.asarray(w, DT)
+
+
+def conv(x, w, s=1):
+    k = w.shape[0]
+    return jax.lax.conv_general_dilated(
+        x, w, (s, s), [(k // 2, k // 2)] * 2, dimension_numbers=DN)
+
+
+def norm(x, p, mode):
+    scale, bias = p
+    if mode == "convonly":
+        return x
+    if mode == "scalebias":
+        return (x.astype(jnp.float32) * scale + bias).astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=(0, 1, 2))
+    v = jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - jnp.square(m)
+    y = (xf - m) / jnp.sqrt(v + 1e-5)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def make_params(n_stages):
+    P = {"stem": (conv_w(7, 3, 64), (jnp.ones(64), jnp.zeros(64)))}
+    strides = {}
+    ci = 64
+    for si in range(n_stages):
+        d, c = DEPTHS[si], CHANS[si]
+        for bi in range(d):
+            pre = f"s{si}b{bi}"
+            co = c * 4
+            strides[pre] = 2 if (bi == 0 and si > 0) else 1
+            blk = {"c1": conv_w(1, ci, c), "b1": (jnp.ones(c), jnp.zeros(c)),
+                   "c2": conv_w(3, c, c), "b2": (jnp.ones(c), jnp.zeros(c)),
+                   "c3": conv_w(1, c, co),
+                   "b3": (jnp.ones(co), jnp.zeros(co))}
+            if ci != co:
+                blk["proj"] = conv_w(1, ci, co)
+                blk["bproj"] = (jnp.ones(co), jnp.zeros(co))
+            P[pre] = blk
+            ci = co
+    return P, strides
+
+
+def forward(P, strides, n_stages, x, mode):
+    x = conv(x, P["stem"][0], 2)
+    x = jax.nn.relu(norm(x, P["stem"][1], "bn"))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1),
+                              [(0, 0), (1, 1), (1, 1), (0, 0)])
+    for si in range(n_stages):
+        m = mode if si == n_stages - 1 else "bn"
+        for bi in range(DEPTHS[si]):
+            blk = P[f"s{si}b{bi}"]
+            s = strides[f"s{si}b{bi}"]
+            idn = x
+            y = jax.nn.relu(norm(conv(x, blk["c1"], 1), blk["b1"], m))
+            y = jax.nn.relu(norm(conv(y, blk["c2"], s), blk["b2"], m))
+            y = norm(conv(y, blk["c3"], 1), blk["b3"], m)
+            if "proj" in blk:
+                idn = norm(conv(idn, blk["proj"], s), blk["bproj"], m)
+            x = jax.nn.relu(y + idn)
+    return jnp.mean(x.astype(jnp.float32))
+
+
+def timed_step(n_stages, x, mode):
+    P, strides = make_params(n_stages)
+
+    @jax.jit
+    def step(P, x):
+        loss, g = jax.value_and_grad(
+            lambda p: forward(p, strides, n_stages, x, mode))(P)
+        P = jax.tree.map(lambda p, gg: p - 0.1 * gg.astype(p.dtype), P, g)
+        return P, loss
+
+    P, loss = step(P, x)
+    np.asarray(_drain(P["stem"][0]))
+    N = 20
+    best = np.inf
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            P, loss = step(P, x)
+        np.asarray(_drain(P["stem"][0]))
+        best = min(best, (time.perf_counter() - t0) / N)
+    return best
+
+
+def main():
+    x = jnp.asarray(rng.standard_normal((B, 224, 224, 3), dtype=np.float32),
+                    DT)
+    t_prev = timed_step(SI, x, "bn")
+    print(f"prefix through s{SI-1}: {t_prev*1e3:.1f} ms", flush=True)
+    for mode in ("bn", "scalebias", "convonly"):
+        t = timed_step(SI + 1, x, mode)
+        print(f"s{SI} as {mode:>9}: prefix {t*1e3:.1f} ms, "
+              f"stage delta {(t-t_prev)*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
