@@ -137,6 +137,14 @@ def _hoist_casts_through_layout(block):
             p.inputs["X"] = [low]
             p.outputs["Out"] = [dst]
             infer_op(p, block)
+            # keep the layout op's ORIGINAL fp32 output fetchable: a user
+            # may fetch it by name even though no op consumes it. The
+            # repair upcast is dead code unless fetched — XLA DCEs it.
+            p_idx = block.ops.index(p)
+            block._insert_op(p_idx + 1, "cast", {"X": [dst]},
+                             {"Out": [src]},
+                             {"in_dtype": op.attr("out_dtype"),
+                              "out_dtype": "float32"})
             changed = True
             break
 

@@ -63,12 +63,118 @@ def _parse_args(argv):
                    help="virtual host devices per process (CPU backend)")
     p.add_argument("--log_dir", default=None,
                    help="write per-worker logs to LOG_DIR/workerlog.N")
+    p.add_argument("--server_num", type=int, default=0,
+                   help="parameter-server mode: spawn this many pservers "
+                        "first (reference launch_ps.py --server_num)")
+    p.add_argument("--worker_num", type=int, default=0,
+                   help="parameter-server mode: trainer count "
+                        "(reference launch_ps.py --worker_num)")
+    p.add_argument("--servers", default=None,
+                   help="explicit pserver endpoint list ip:port,ip:port "
+                        "(default: node_ip with free ports)")
     p.add_argument("training_script", help="the script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
+def launch_ps(args) -> int:
+    """Parameter-server cluster launcher (reference
+    python/paddle/distributed/launch_ps.py:55-82 start_procs): spawn
+    --server_num pservers, then --worker_num trainers, all running the SAME
+    training script; roles arrive via TRAINING_ROLE/PADDLE_* envs that the
+    fleet RoleMakers (incubate/fleet/base.py PaddleCloudRoleMaker) read.
+    Returns when every trainer exits (pservers are then terminated, matching
+    the reference's procs[i].proc.terminate() for servers)."""
+    n_servers = args.server_num
+    n_workers = args.worker_num or 1
+    if args.servers:
+        server_eps = [e for e in args.servers.split(",") if e]
+        if len(server_eps) != n_servers and args.server_num:
+            n_servers = len(server_eps)
+    else:
+        server_eps = [f"{args.node_ip}:{_free_port()}"
+                      for _ in range(n_servers)]
+    base_port = args.started_port or _free_port()
+    trainer_eps = [f"{args.node_ip}:{base_port + i}" for i in range(n_workers)]
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    ps_authkey = os.environ.get("PADDLE_PS_AUTHKEY") or secrets.token_hex(16)
+
+    common = {
+        "PADDLE_PS_AUTHKEY": ps_authkey,
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(server_eps),
+        "PADDLE_PSERVER_ENDPOINTS": ",".join(server_eps),
+        "PADDLE_TRAINERS_NUM": str(n_workers),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(trainer_eps),
+    }
+    if args.backend:
+        common["PADDLE_DIST_BACKEND"] = args.backend
+
+    def _spawn(role_env, tag):
+        env = dict(os.environ)
+        env.update(common)
+        env.update(role_env)
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir, f"{tag}.log"), "w")
+            logs.append(out)
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        return subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+
+    logs: list = []
+    servers = [
+        _spawn({"TRAINING_ROLE": "PSERVER", "PADDLE_PSERVER_ID": str(i),
+                "PADDLE_CURRENT_ENDPOINT": ep, "PADDLE_PORT": ep.rsplit(":", 1)[1],
+                "POD_IP": ep.rsplit(":", 1)[0]}, f"serverlog.{i}")
+        for i, ep in enumerate(server_eps)
+    ]
+    workers = [
+        _spawn({"TRAINING_ROLE": "TRAINER", "PADDLE_TRAINER_ID": str(i),
+                "PADDLE_CURRENT_ENDPOINT": trainer_eps[i]}, f"workerlog.{i}")
+        for i in range(n_workers)
+    ]
+
+    rc = 0
+    try:
+        # poll loop (same discipline as the collective launch() below): one
+        # crashed trainer must tear the whole job down — a sequential wait()
+        # would hang forever on the surviving trainers' barriers
+        alive = set(range(n_workers))
+        while alive:
+            for i in list(alive):
+                r = workers[i].poll()
+                if r is None:
+                    continue
+                alive.discard(i)
+                if r != 0:
+                    rc = r
+                    for w in workers:
+                        if w.poll() is None:
+                            w.send_signal(signal.SIGTERM)
+                    alive.clear()
+            time.sleep(0.1)
+        # trainers done (or failed): tear the servers down
+        stop = list(servers) + ([w for w in workers if w.poll() is None]
+                                if rc else [])
+        for s in stop:
+            if s.poll() is None:
+                s.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for s in stop:
+            try:
+                s.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                s.kill()
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
 def launch(args) -> int:
+    if args.server_num or args.worker_num:
+        return launch_ps(args)
     n = args.nproc_per_node
     coordinator = args.coordinator or f"{args.node_ip}:{_free_port()}"
     base_port = args.started_port or _free_port()

@@ -6,9 +6,13 @@ TPU-native replacement for the reference RPC stack:
     fetch_barrier/send_complete)
   * `listen_and_serv` event loop (distributed_ops/listen_and_serv_op.cc) +
     RequestSend/Get handlers (request_handler_impl.cc) -> `PServerRuntime`
-  * gRPC ByteBuffer serde (grpc/grpc_serde.cc) -> length-prefixed pickles over
-    `multiprocessing.connection` (localhost/DCN; trusted-cluster assumption,
-    authkey-protected)
+  * gRPC ByteBuffer serde (grpc/grpc_serde.cc) -> a length-prefixed raw
+    tensor frame over `multiprocessing.connection` byte pipes: a small JSON
+    meta header (op, name, trainer, dtype/shape table) followed by the raw
+    tensor bytes, decoded with zero-copy np.frombuffer views. No pickle on
+    the wire — version-stable and copy-light, the same serde discipline as
+    the reference's zero-copy gRPC ByteBuffer path. The connection-level
+    HMAC challenge (authkey) is kept for transport auth.
 
 Sync semantics (sync_mode=True): the server buffers each trainer's gradient
 per variable; when every trainer has posted its send_barrier, gradients are
@@ -37,6 +41,50 @@ def _authkey() -> bytes:
 def _parse_ep(ep: str):
     host, port = ep.rsplit(":", 1)
     return (host, int(port))
+
+
+# -- wire frame: JSON meta + raw tensor blocks --------------------------------
+# frame := u32(meta_len) meta_json tensor_bytes*
+# meta["_t"] = [[dtype_str, shape], ...] describes the appended raw blocks in
+# order; everything else in meta is small scalars/strings. send_bytes adds the
+# outer length prefix. SelectedRows travel as two blocks (rows, values) plus
+# a "height" field; replies are {"s": "ok"|"err", ...} frames.
+
+import json as _json
+import struct as _struct
+
+
+def _pack(meta: dict, tensors=()) -> bytes:
+    tensors = [np.asarray(t) for t in tensors]
+    meta = dict(meta)
+    # shapes recorded BEFORE ascontiguousarray (it promotes 0-d to 1-d)
+    meta["_t"] = [[t.dtype.str, list(t.shape)] for t in tensors]
+    mb = _json.dumps(meta, separators=(",", ":")).encode()
+    parts = [_struct.pack("<I", len(mb)), mb]
+    parts += [memoryview(np.ascontiguousarray(t)).cast("B") for t in tensors]
+    return b"".join(parts)
+
+
+def _unpack(buf):
+    (mlen,) = _struct.unpack_from("<I", buf, 0)
+    meta = _json.loads(bytes(buf[4:4 + mlen]).decode())
+    off = 4 + mlen
+    tensors = []
+    for dtype_str, shape in meta.pop("_t", []):
+        dt = np.dtype(dtype_str)
+        n = int(np.prod(shape)) if shape else 1
+        t = np.frombuffer(buf, dt, count=n, offset=off).reshape(tuple(shape))
+        off += n * dt.itemsize
+        tensors.append(t)
+    return meta, tensors
+
+
+def _reply_ok(conn, tensors=(), **fields):
+    conn.send_bytes(_pack({"s": "ok", **fields}, tensors))
+
+
+def _reply_err(conn, msg: str):
+    conn.send_bytes(_pack({"s": "err", "msg": msg}))
 
 
 # -- wire contract for row-sliced variables ----------------------------------
@@ -138,35 +186,41 @@ class PSClient:
                         time.sleep(0.2)  # server may still be starting
         return self._conns[ep], lock
 
-    def _call(self, ep: str, msg: dict) -> Any:
+    def _call(self, ep: str, meta: dict, tensors=()):
+        """One framed request/reply round; returns (meta, tensors)."""
         conn, lock = self._conn(ep)
         with lock:
-            conn.send(msg)
-            kind, payload = conn.recv()
-        if kind == "err":
-            raise RuntimeError(f"pserver {ep}: {payload}")
-        return payload
+            conn.send_bytes(_pack(meta, tensors))
+            buf = conn.recv_bytes()
+        rmeta, rtensors = _unpack(buf)
+        if rmeta.get("s") == "err":
+            raise RuntimeError(f"pserver {ep}: {rmeta.get('msg')}")
+        return rmeta, rtensors
 
     # -- RPCClient contract --------------------------------------------------
     def send_var(self, ep: str, name: str, value) -> None:
         if hasattr(value, "rows"):  # SelectedRows
-            payload = ("sparse", np.asarray(value.rows),
-                       np.asarray(value.values), value.height)
+            self._call(ep, {"op": "send", "name": name,
+                            "trainer": self.trainer_id, "kind": "sparse",
+                            "height": int(value.height)},
+                       [np.asarray(value.rows), np.asarray(value.values)])
         else:
-            payload = ("dense", np.asarray(value))
-        self._call(ep, {"op": "send", "name": name,
-                        "trainer": self.trainer_id, "value": payload})
+            self._call(ep, {"op": "send", "name": name,
+                            "trainer": self.trainer_id, "kind": "dense"},
+                       [np.asarray(value)])
 
     def get_var(self, ep: str, name: str) -> np.ndarray:
-        return self._call(ep, {"op": "get", "name": name,
-                               "trainer": self.trainer_id})
+        _, (v,) = self._call(ep, {"op": "get", "name": name,
+                                  "trainer": self.trainer_id})
+        return v
 
     def prefetch(self, ep: str, name: str, ids) -> np.ndarray:
         """Fetch only the given (slice-local) rows of a server-resident
         table (reference RPCClient::AsyncPrefetchVar rpc_client.h:62 +
         RequestPrefetchHandler) — the whole table never travels."""
-        return self._call(ep, {"op": "prefetch", "name": name,
-                               "ids": np.asarray(ids, np.int64)})
+        _, (v,) = self._call(ep, {"op": "prefetch", "name": name},
+                             [np.asarray(ids, np.int64)])
+        return v
 
     def send_barrier(self) -> None:
         """Blocks until the server has aggregated + applied this round."""
@@ -282,7 +336,7 @@ class PServerRuntime:
                 self._barriers_seen = set()
                 for c in waiting:
                     try:
-                        c.send(("ok", None))
+                        _reply_ok(c)
                     except Exception:
                         pass
                 return None  # replies already sent
@@ -455,6 +509,18 @@ class PServerRuntime:
             self.scope._vars = snapshot
 
     def serve(self):
+        import os
+
+        host = _parse_ep(self.endpoint)[0]
+        if (host not in ("127.0.0.1", "localhost", "::1")
+                and not os.environ.get("PADDLE_PS_AUTHKEY")):
+            # the built-in fallback authkey is not a boundary; a bind on a
+            # routable address without an explicit launch secret would accept
+            # writes from anything on the network
+            raise RuntimeError(
+                f"refusing to bind pserver on non-loopback '{self.endpoint}' "
+                "with the default authkey — export PADDLE_PS_AUTHKEY (the "
+                "launcher does this automatically)")
         self._warm_optimize_programs()
         listener = Listener(_parse_ep(self.endpoint), authkey=_authkey())
         threads = []
@@ -481,23 +547,34 @@ class PServerRuntime:
     def _client_loop(self, conn):
         while not self._shutdown.is_set():
             try:
-                msg = conn.recv()
+                buf = conn.recv_bytes()
             except (EOFError, OSError):
                 return
             try:
+                msg, tensors = _unpack(buf)
                 op = msg["op"]
+                # reconstruct the handler-facing payload tuples from the raw
+                # tensor blocks (frame kinds: dense/sparse/delta)
                 if op == "send":
-                    conn.send(("ok", self._handle_send(msg)))
+                    kind = msg["kind"]
+                    if kind == "sparse":
+                        msg["value"] = ("sparse", tensors[0], tensors[1],
+                                        msg["height"])
+                    else:
+                        msg["value"] = (kind, tensors[0])
+                    self._handle_send(msg)
+                    _reply_ok(conn)
                 elif op == "get":
-                    conn.send(("ok", self._handle_get(msg)))
+                    _reply_ok(conn, [self._handle_get(msg)])
                 elif op == "prefetch":
-                    conn.send(("ok", self._handle_prefetch(msg)))
+                    msg["ids"] = tensors[0]
+                    _reply_ok(conn, [self._handle_prefetch(msg)])
                 elif op == "barrier":
                     r = self._handle_barrier(msg, conn)
                     if r == "wait":
                         pass  # reply comes when the round completes
                 elif op == "checkpoint":
-                    conn.send(("ok", self._handle_checkpoint(msg)))
+                    _reply_ok(conn, path=self._handle_checkpoint(msg))
                 elif op == "complete":
                     with self._lock:
                         self._completed.add(msg["trainer"])
@@ -509,20 +586,20 @@ class PServerRuntime:
                             self._run_round()
                             for c in self._barrier_waiting:
                                 try:
-                                    c.send(("ok", None))
+                                    _reply_ok(c)
                                 except Exception:
                                     pass
                             self._barrier_waiting = []
                             self._barriers_seen = set()
-                    conn.send(("ok", None))
+                    _reply_ok(conn)
                     if done:
                         self._signal_shutdown()
                         return
                 else:
-                    conn.send(("err", f"unknown op {msg['op']}"))
+                    _reply_err(conn, f"unknown op {msg['op']}")
             except Exception as e:  # serve must not die on one bad request
                 try:
-                    conn.send(("err", f"{type(e).__name__}: {e}"))
+                    _reply_err(conn, f"{type(e).__name__}: {e}")
                 except Exception:
                     return
 
@@ -533,5 +610,5 @@ def send_delta_sections(client, name: str, delta, epmap, sections) -> None:
     slicing math cannot drift from send_sections."""
     for ep, wire, part in iter_sections(name, delta, epmap, sections):
         client._call(ep, {"op": "send", "name": wire,
-                          "trainer": client.trainer_id,
-                          "value": ("delta", np.asarray(part))})
+                          "trainer": client.trainer_id, "kind": "delta"},
+                     [np.asarray(part)])

@@ -416,7 +416,13 @@ class Executor:
         fetch_list: Sequence | None = None,
         scope: Scope | None = None,
         return_numpy: bool = True,
+        rng_counter: int | None = None,
     ):
+        """rng_counter: caller-controlled replacement for the scope run
+        counter in the PRNG key derivation. Two runs of programs sharing a
+        random_seed and an op prefix draw IDENTICAL per-op keys when given
+        the same counter — how the pipeline backward replay reproduces the
+        forward's dropout masks exactly (parallel/pipeline.py)."""
         from .compiler import CompiledProgram  # lazy; avoids cycle
 
         mesh = None
@@ -509,7 +515,9 @@ class Executor:
             rw_vals = tuple(_to_global(v, s) for v, s in zip(rw_vals, rw_sh))
         scope._run_counter += 1
         key = jax.random.PRNGKey(program.random_seed or 0)
-        key = jax.random.fold_in(key, scope._run_counter)
+        key = jax.random.fold_in(
+            key,
+            scope._run_counter if rng_counter is None else int(rng_counter))
 
         if flags.get_flag("check_nan_inf"):
             # debug mode: run the whole block eagerly so per-op outputs are

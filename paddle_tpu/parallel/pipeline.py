@@ -30,9 +30,19 @@ pipeline_trainer.cc) carried by XLA async dispatch instead of section
 threads + scope queues. Without `devices` the same schedule runs on one
 device and buys only activation memory (peak / num_microbatches).
 
-Known departure: the backward replay re-draws RNG (dropout masks differ
-between forward and recompute). Use dropout only where the estimator may be
-stochastic, as with any remat-without-saved-rng scheme.
+Schedules: "gpipe" (all forwards, then all backwards — activation stash
+grows with num_microbatches) and "1f1b" (PipeDream-flush steady state —
+stage s runs S-1-s warmup forwards then alternates one-forward-one-backward,
+so at most ~n_stages microbatches are in flight and the boundary stash is
+freed as each microbatch's backward completes; reference SectionWorker's
+steady-state concurrency, trainer.h:110). Both schedules produce identical
+numerics (same per-microbatch grads, one optimizer step on the mean).
+
+RNG correctness: the backward program replays the stage's forward ops, and
+both runs draw their per-op PRNG keys from the same caller-supplied
+rng_counter (Executor.run rng_counter=...), so dropout masks in the
+recompute are bit-identical to the forward's — the TPU analogue of the
+reference stashing per-microbatch scopes and replaying them.
 """
 from __future__ import annotations
 
@@ -75,6 +85,7 @@ def _copy_var(dst_block, src_var: Variable, as_feed: bool = False) -> Variable:
             do_model_average=src_var.do_model_average,
             optimize_attr=dict(src_var.optimize_attr or {}),
         )
+        p.sharding = src_var.sharding  # keep tp/sp GSPMD annotations
         dst_block.vars[p.name] = p
         return p
     return dst_block.create_var(
@@ -84,6 +95,7 @@ def _copy_var(dst_block, src_var: Variable, as_feed: bool = False) -> Variable:
         persistable=src_var.persistable,
         stop_gradient=src_var.stop_gradient and not as_feed,
         is_data=as_feed or src_var.is_data,
+        sharding=src_var.sharding,
     )
 
 
@@ -177,7 +189,7 @@ def resolve_devices(place_list, n_stages: int):
 def build_pipeline_plan(program: Program, loss: Variable, cut_vars,
                         inner_opt, num_microbatches: int,
                         startup_program: Program | None = None,
-                        devices=None):
+                        devices=None, schedule: str = "1f1b", mesh=None):
     """Split `program` (forward-only) at `cut_vars` into a PipelinePlan."""
     from ..backward import gradients
 
@@ -314,7 +326,8 @@ def build_pipeline_plan(program: Program, loss: Variable, cut_vars,
             opt.apply_gradients(pairs)
 
     return PipelinePlan(stages, loss.name, num_microbatches,
-                        devices=resolve_devices(devices, n_stages))
+                        devices=resolve_devices(devices, n_stages),
+                        schedule=schedule, mesh=mesh)
 
 
 def _is_float(v: Variable) -> bool:
@@ -328,16 +341,35 @@ class PipelinePlan:
     PipelineTrainer/SectionWorker equivalent, host-driven)."""
 
     def __init__(self, stages: list[_Stage], loss_name: str,
-                 num_microbatches: int, devices=None):
+                 num_microbatches: int, devices=None,
+                 schedule: str = "1f1b", mesh=None):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule '{schedule}'")
+        if mesh is not None and devices is not None:
+            raise NotImplementedError(
+                "pipeline mesh= (tp-sharded stages over one shared mesh) "
+                "and devices= (one device per stage) are mutually "
+                "exclusive; per-stage sub-meshes are not supported yet")
+        # tp x pp composition: every stage program is compiled GSPMD over
+        # this shared mesh (the model's tp/sp annotations shard within the
+        # stage; the microbatch loop provides pp). The mesh must not carry
+        # a dp axis — feeds replicate, per-var annotations shard.
+        self.mesh = mesh
+        self._compiled_cache: dict | None = None
         self.stages = stages
         self.loss_name = loss_name
         self.num_microbatches = num_microbatches
         self.devices = devices
+        self.schedule = schedule
         # dispatch order of the last run_step, [("f"|"b", stage, microbatch)]
         # — observable evidence of the clock-cycle interleave (tests assert
         # stage s+1 starts before stage s drains; the reference's analogue is
         # SectionWorker threads consuming scope queues concurrently)
         self.last_dispatch: list[tuple] = []
+        # max #microbatches with live boundary stash during the last step —
+        # the 1f1b memory claim is peak <= n_stages + 1 (vs M for gpipe)
+        self.last_peak_stash: int = 0
+        self._step_counter = 0
         if devices is not None:
             self._check_no_cross_stage_params()
 
@@ -361,6 +393,22 @@ class PipelinePlan:
         if isinstance(v, jax.Array) and dev not in v.devices():
             return jax.device_put(v, dev)
         return v
+
+    def _stage_prog(self, s: int, which: str):
+        """The runnable for stage s's `which` program: the raw Program, or
+        (mesh mode) a CompiledProgram over the shared tp mesh, cached."""
+        prog = getattr(self.stages[s], which)
+        if self.mesh is None or prog is None:
+            return prog
+        if self._compiled_cache is None:
+            self._compiled_cache = {}
+        key = (s, which)
+        if key not in self._compiled_cache:
+            from ..compiler import CompiledProgram
+
+            self._compiled_cache[key] = CompiledProgram(
+                prog).with_data_parallel(mesh=self.mesh)
+        return self._compiled_cache[key]
 
     def _place_stage_state(self, scope):
         """device_put each stage's scope-resident state (params, BN stats,
@@ -428,6 +476,30 @@ class PipelinePlan:
         if self.devices is not None:
             self._place_stage_state(scope)
         self.last_dispatch = []
+        self.last_peak_stash = 0
+        # per-(step, stage, microbatch) PRNG counter shared by the forward
+        # run and the backward replay: identical op prefix + identical key
+        # => identical dropout masks in the recompute (Executor.run
+        # rng_counter). The 2^30 offset keeps the range disjoint from the
+        # scope's own small run counters used by non-pipeline runs
+        # (fold_in requires uint32, so negatives are out).
+        self._step_counter += 1
+        base = (1 << 30) + self._step_counter * S * M
+
+        def _rng(s, m):
+            return base + s * M + m
+
+        # the boundary stash entry for var n (produced at stage ps) is last
+        # read by the backward of its LOWEST consumer stage — free it there
+        free_at: dict[str, int] = {}
+        for s, stage in enumerate(self.stages):
+            for n in stage.ext_inputs:
+                if any(n in st.out_names for st in self.stages[:s]):
+                    free_at[n] = min(free_at.get(n, S), s)
+
+        def _note_peak(stash):
+            live = sum(1 for d in stash if d)
+            self.last_peak_stash = max(self.last_peak_stash, live)
 
         def _fwd_one(s, m, stash, fetched):
             stage = self.stages[s]
@@ -441,26 +513,16 @@ class PipelinePlan:
             missing = [n for n in stage.ext_inputs if n not in f]
             if missing:
                 raise KeyError(f"pipeline stage {s} needs feeds {missing}")
-            outs = exe.run(stage.fwd, feed=f, fetch_list=wanted,
-                           scope=scope, return_numpy=False)
+            outs = exe.run(self._stage_prog(s, "fwd"), feed=f,
+                           fetch_list=wanted, scope=scope,
+                           return_numpy=False, rng_counter=_rng(s, m))
             self.last_dispatch.append(("f", s, m))
             for n, v in zip(wanted, outs):
                 if n in stage.out_names:
                     stash[m][n] = v
                 if n in fetched:
                     fetched[n].append(v)
-
-        # --- forward: GPipe clock cycles — cycle t dispatches stage s on
-        # microbatch t-s, so with device placement stage s computes
-        # microbatch m while stage s+1 computes m-1 (async XLA dispatch on
-        # distinct devices = the SectionWorker overlap)
-        stash: list[dict[str, Any]] = [dict() for _ in range(M)]
-        fetched: dict[str, list] = {n: [] for n in fetch_names}
-        for t in range(S + M - 1):
-            for s in range(S):
-                m = t - s
-                if 0 <= m < M:
-                    _fwd_one(s, m, stash, fetched)
+            _note_peak(stash)
 
         def _bwd_one(s, m, stash, grad_stash, grad_acc):
             stage = self.stages[s]
@@ -481,8 +543,9 @@ class PipelinePlan:
                              for d in ov.shape]
                     g = np.zeros(shape, ov.np_dtype)
                 f[n + _GRAD_IN_SUFFIX] = self._to_dev(g, devs[s])
-            outs = exe.run(stage.bwd, feed=f, fetch_list=wanted,
-                           scope=scope, return_numpy=False)
+            outs = exe.run(self._stage_prog(s, "bwd"), feed=f,
+                           fetch_list=wanted, scope=scope,
+                           return_numpy=False, rng_counter=_rng(s, m))
             self.last_dispatch.append(("b", s, m))
             outs = list(outs)
             for (p, _), v in zip(pg_names, outs[: len(pg_names)]):
@@ -493,18 +556,78 @@ class PipelinePlan:
                 if prev is not None:
                     v = self._to_dev(v, _device_of(prev))
                 grad_stash[m][n] = v if prev is None else prev + v
+            # this backward was the last reader of m's inputs at this stage
+            # and of m's cotangents for this stage's outputs
+            for n in [n for n, fs in free_at.items() if fs == s]:
+                stash[m].pop(n, None)
+            for n in stage.out_names:
+                grad_stash[m].pop(n, None)
 
-        # --- backward: reverse clock cycles (stage S-1 leads, stage s runs
-        # microbatch m at cycle (S-1-s)+m); every consumer stage s' > s of a
-        # boundary var finishes microbatch m strictly before stage s needs
-        # its cotangent. Param grads accumulate on the stage's device.
+        stash: list[dict[str, Any]] = [dict() for _ in range(M)]
+        fetched: dict[str, list] = {n: [] for n in fetch_names}
         grad_acc: dict[str, Any] = {}
         grad_stash: list[dict[str, Any]] = [dict() for _ in range(M)]
-        for t in range(S + M - 1):
-            for s in range(S - 1, -1, -1):
-                m = t - (S - 1 - s)
-                if 0 <= m < M:
-                    _bwd_one(s, m, stash, grad_stash, grad_acc)
+
+        if self.schedule == "gpipe":
+            # --- forward: GPipe clock cycles — cycle t dispatches stage s on
+            # microbatch t-s, so with device placement stage s computes
+            # microbatch m while stage s+1 computes m-1 (async XLA dispatch
+            # on distinct devices = the SectionWorker overlap)
+            for t in range(S + M - 1):
+                for s in range(S):
+                    m = t - s
+                    if 0 <= m < M:
+                        _fwd_one(s, m, stash, fetched)
+            # --- backward: reverse clock cycles (stage S-1 leads, stage s
+            # runs microbatch m at cycle (S-1-s)+m); every consumer stage
+            # s' > s of a boundary var finishes microbatch m strictly before
+            # stage s needs its cotangent.
+            for t in range(S + M - 1):
+                for s in range(S - 1, -1, -1):
+                    m = t - (S - 1 - s)
+                    if 0 <= m < M:
+                        _bwd_one(s, m, stash, grad_stash, grad_acc)
+        else:
+            # --- 1F1B (PipeDream-flush): stage s runs min(S-1-s, M) warmup
+            # forwards, then alternates forward/backward in steady state,
+            # then drains. Dependency-driven dispatch: each round every
+            # stage advances at most one op when its deps are met — fwd(s,m)
+            # after fwd(s-1,m); bwd(s,m) after fwd(s,m) and bwd(s+1,m).
+            local: list[list[str]] = []
+            for s in range(S):
+                w = min(S - 1 - s, M)
+                local.append(["f"] * w + ["f", "b"] * (M - w) + ["b"] * w)
+            pc = [0] * S
+            fcnt = [0] * S
+            bcnt = [0] * S
+            fwd_done = [[False] * M for _ in range(S)]
+            bwd_done = [[False] * M for _ in range(S)]
+            while any(pc[s] < len(local[s]) for s in range(S)):
+                progressed = False
+                for s in range(S):
+                    if pc[s] >= len(local[s]):
+                        continue
+                    kind = local[s][pc[s]]
+                    if kind == "f":
+                        m = fcnt[s]
+                        if s > 0 and not fwd_done[s - 1][m]:
+                            continue
+                        _fwd_one(s, m, stash, fetched)
+                        fwd_done[s][m] = True
+                        fcnt[s] += 1
+                    else:
+                        m = bcnt[s]
+                        if not fwd_done[s][m] or (
+                                s < S - 1 and not bwd_done[s + 1][m]):
+                            continue
+                        _bwd_one(s, m, stash, grad_stash, grad_acc)
+                        bwd_done[s][m] = True
+                        bcnt[s] += 1
+                    pc[s] += 1
+                    progressed = True
+                if not progressed:
+                    raise RuntimeError(
+                        "1F1B schedule deadlocked — dependency bug")
 
         # --- update: one optimizer step on mean-of-microbatch grads ---------
         inv = 1.0 / M
@@ -512,7 +635,8 @@ class PipelinePlan:
             if stage.update is None or not stage.update_feed:
                 continue
             f = {g: grad_acc[p] * inv for p, g in stage.update_feed.items()}
-            exe.run(stage.update, feed=f, scope=scope)
+            exe.run(self._stage_prog(stage.idx, "update"), feed=f,
+                    scope=scope)
 
         # --- assemble fetches ------------------------------------------------
         # batch-dim fetches (declared leading dim -1) concatenate across

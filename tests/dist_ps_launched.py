@@ -1,0 +1,74 @@
+"""Env-driven fleet PS runner for the launch_ps launcher test: every role
+and endpoint arrives via the PADDLE_* env contract that
+`python -m paddle_tpu.distributed.launch --server_num N --worker_num M`
+exports (reference launch_ps.py start_procs) — no positional role args.
+
+usage: dist_ps_launched.py OUT_DIR
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+from paddle_tpu.incubate.fleet.base import PaddleCloudRoleMaker  # noqa: E402
+from paddle_tpu.incubate.fleet.parameter_server import fleet  # noqa: E402
+
+STEPS = 5
+FULL_BATCH = 32
+
+
+def main():
+    out_dir = sys.argv[1]
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[16], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            h = L.fc(x, size=512, act="relu")
+            pred = L.fc(h, size=1)
+            loss = L.mean(L.square_error_cost(pred, y))
+            fleet.init(PaddleCloudRoleMaker())
+            opt = fleet.distributed_optimizer(pt.optimizer.SGD(0.1))
+            opt.minimize(loss)
+
+    if fleet.is_server():
+        with pt.program_guard(main_p, startup):
+            fleet.init_server()
+            fleet.run_server()
+        return
+
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    exe = pt.Executor()
+    with pt.program_guard(main_p, startup):
+        exe.run(startup)
+        fleet.init_worker()
+        rng = np.random.default_rng(0)
+        x_all = rng.standard_normal((FULL_BATCH, 16)).astype(np.float32)
+        w = rng.standard_normal((16, 1)).astype(np.float32)
+        y_all = (x_all @ w).astype(np.float32)
+        shard = FULL_BATCH // n
+        lo = tid * shard
+        losses = []
+        for _ in range(STEPS):
+            (lv,) = exe.run(fleet.main_program,
+                            feed={"x": x_all[lo:lo + shard],
+                                  "y": y_all[lo:lo + shard]},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv)))
+        fleet.stop_worker()
+    vals = {p.name: np.asarray(pt.global_scope().find_var(p.name))
+            for p in main_p.all_parameters()}
+    vals["__losses__"] = np.asarray(losses)
+    np.savez(os.path.join(out_dir, f"trainer{tid}.npz"), **vals)
+
+
+if __name__ == "__main__":
+    main()

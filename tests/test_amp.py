@@ -144,15 +144,17 @@ def test_amp_cast_hoist_through_layout_ops():
     create a second producer of an existing @BF16 var when the same fp32
     source also feeds a white op directly (r5: double-producer made
     append_backward sum both cast_grads -> 1.5x gradients)."""
-    x = L.data(name="x", shape=[4, 6], dtype="float32")
-    # shared fp32 intermediate with a learnable producer: its (possibly
-    # corrupted) grad propagates into shared's weight grad, which we fetch
-    z = L.fc(x, size=24, act="relu", name="shared")
-    a = L.fc(z, size=3)                     # white op consumes z directly
-    r = L.reshape(z, [-1, 4, 6])            # layout chain then white op
-    r = L.transpose(r, [0, 2, 1])
-    b = L.fc(r, size=3)
-    loss = L.mean(a) + L.mean(b)
+    def build():
+        x = L.data(name="x", shape=[4, 6], dtype="float32")
+        h = L.fc(x, size=24, name="shared")
+        z = L.exp(h)            # black op: z is genuinely float32
+        a = L.fc(z, size=3)     # white op consumes z directly (z@BF16)
+        r = L.reshape(z, [-1, 4, 6])
+        r2 = L.transpose(r, [0, 2, 1])
+        b = L.fc(r2, size=3)
+        return z, r2, L.mean(a) + L.mean(b)
+
+    z, r2, loss = build()
     main = pt.default_main_program()
     amp.rewrite_program(main, amp.AutoMixedPrecisionLists(), "bfloat16")
     block = main.global_block
@@ -163,9 +165,11 @@ def test_amp_cast_hoist_through_layout_ops():
             assert n not in producers, f"two producers for {n}: " \
                 f"{producers[n].type} and {op.type}"
             producers[n] = op
-    # the reshape now consumes a bf16 view, not fp32
+    # the hoist actually fired: the reshape now consumes a bf16 view of z,
+    # not the fp32 z itself
     (reshape_op,) = [op for op in block.ops if op.type == "reshape2"]
     (rin,) = reshape_op.input("X")
+    assert rin != z.name, "cast was not hoisted above the layout chain"
     assert "bf16" in str(block.var(rin).dtype.value).replace("loat", ""), rin
     pt.backward.append_backward(loss)
     w_shared = main.all_parameters()[0].name
@@ -175,16 +179,14 @@ def test_amp_cast_hoist_through_layout_ops():
     feed = {"x": rng.standard_normal((2, 4, 6)).astype(np.float32)}
     params = [np.array(pt.global_scope().find_var(p.name))
               for p in main.all_parameters()]
-    (gw,) = exe.run(main, feed=feed, fetch_list=[w_shared + "@GRAD"])
-    # fp32 oracle built fresh with the same params
+    # the layout op's ORIGINAL fp32 output must stay fetchable post-hoist
+    # (a repair upcast keeps it producible; DCE'd when unfetched)
+    gw, r_val = exe.run(main, feed=feed,
+                        fetch_list=[w_shared + "@GRAD", r2.name])
+    assert np.asarray(r_val).dtype == np.float32
+    # gradient oracle: same graph, no AMP rewrite, same params
     with pt.program_guard(pt.Program(), pt.Program()):
-        x2 = L.data(name="x", shape=[4, 6], dtype="float32")
-        z2 = L.fc(x2, size=24, act="relu", name="shared")
-        a2 = L.fc(z2, size=3)
-        r2 = L.reshape(z2, [-1, 4, 6])
-        r2 = L.transpose(r2, [0, 2, 1])
-        b2 = L.fc(r2, size=3)
-        loss2 = L.mean(a2) + L.mean(b2)
+        _, _, loss2 = build()
         main2 = pt.default_main_program()
         pt.backward.append_backward(loss2)
         w2 = main2.all_parameters()[0].name

@@ -134,13 +134,13 @@ def test_geo_communicator_push_pull_cycle():
     class FakeClient:
         trainer_id = 0
 
-        def _call(self, ep, msg):
-            if msg["op"] == "send":
-                kind, delta = msg["value"]
-                assert kind == "delta"
+        def _call(self, ep, meta, tensors=()):
+            if meta["op"] == "send":
+                assert meta["kind"] == "delta"
+                (delta,) = tensors
                 server["w"] = server["w"] + delta
-                return True
-            raise AssertionError(msg)
+                return {"s": "ok"}, []
+            raise AssertionError(meta)
 
         def get_var(self, ep, name):
             return server["w"].copy()

@@ -58,3 +58,30 @@ def test_launch_two_process_collective_matches_local(tmp_path):
         np.testing.assert_allclose(
             r0[k], r1[k], rtol=1e-6, atol=1e-7,
             err_msg=f"ranks disagree on param {k}")
+
+
+def test_launch_ps_spawns_servers_and_workers(tmp_path):
+    """`launch --server_num --worker_num` drives a real 2-server/2-trainer
+    fleet job end-to-end: roles arrive via the exported PADDLE_* envs
+    (reference launch_ps.py:55-82), trainers converge and agree (sync)."""
+    script = os.path.join(_DIR, "dist_ps_launched.py")
+    p = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--server_num=2", "--worker_num=2",
+         f"--log_dir={tmp_path / 'logs'}", script, str(tmp_path)],
+        env=_env(), capture_output=True, timeout=300)
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.exists():
+        for f in sorted(logdir.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+    assert p.returncode == 0, (p.stdout.decode()[-1000:],
+                               p.stderr.decode()[-1000:], logs[-6000:])
+    t0 = np.load(tmp_path / "trainer0.npz")
+    t1 = np.load(tmp_path / "trainer1.npz")
+    losses = t0["__losses__"]
+    assert losses[-1] < losses[0], losses
+    for k in t0.files:
+        if k.startswith("__"):
+            continue
+        np.testing.assert_allclose(t0[k], t1[k], rtol=1e-5, atol=1e-6)

@@ -276,3 +276,34 @@ def test_distributed_lookup_table_matches_local_dense(tmp_path):
         np.testing.assert_allclose(
             local[k], tr0[k], rtol=1e-4, atol=1e-5,
             err_msg=f"dist-lookup param {k} diverged from local dense")
+
+
+def test_wire_frame_roundtrip_and_auth_refusal():
+    """The PS wire format is a length-prefixed raw-tensor frame (JSON meta +
+    raw blocks), not pickle: roundtrip preserves dtype/shape/values with
+    zero-copy views, and a pserver refuses to bind a routable address with
+    the default authkey (r4 weak #4)."""
+    import pytest
+    from paddle_tpu.distributed.ps_rpc import PServerRuntime, _pack, _unpack
+
+    rng = np.random.default_rng(0)
+    tensors = [rng.standard_normal((3, 5)).astype(np.float32),
+               rng.integers(0, 9, 7).astype(np.int64),
+               np.float32(2.5).reshape(())]  # 0-d
+    meta = {"op": "send", "name": "w.block0", "trainer": 3, "kind": "sparse",
+            "height": 100}
+    buf = _pack(meta, tensors)
+    assert isinstance(buf, bytes)
+    assert b"cnumpy" not in buf and b"pickle" not in buf  # no pickle opcodes
+    out_meta, out = _unpack(buf)
+    assert out_meta == meta
+    for a, b in zip(tensors, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+    import paddle_tpu as pt
+    srv = PServerRuntime("0.0.0.0:29599", n_trainers=1, sync_mode=True,
+                         blocks=[], scope=pt.Scope(), executor=pt.Executor())
+    assert "PADDLE_PS_AUTHKEY" not in os.environ
+    with pytest.raises(RuntimeError, match="non-loopback"):
+        srv.serve()

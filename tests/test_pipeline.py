@@ -257,3 +257,126 @@ def test_pipeline_placement_rejects_tied_weights():
                 pt.optimizer.PipelineOptimizer(
                     pt.optimizer.SGD(0.1), cut_list=[[b]],
                     place_list=[devs[0], devs[1]]).minimize(loss)
+
+
+def _build_3stage(num_micro, schedule):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[16], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            h1 = L.fc(x, size=12, act="relu")
+            h2 = L.fc(h1, size=8, act="relu")
+            pred = L.fc(h2, size=1)
+            loss = L.mean(L.square_error_cost(pred, y))
+            from paddle_tpu.parallel.pipeline import build_pipeline_plan
+            main._pipeline = build_pipeline_plan(
+                main, loss, [h1, h2], pt.optimizer.SGD(0.05), num_micro,
+                startup, schedule=schedule)
+    return main, startup, loss
+
+
+def test_1f1b_schedule_order_and_stash_bound():
+    """1F1B: stage s runs min(S-1-s, M) warmup forwards then strictly
+    alternates F/B then drains; the boundary stash never holds more than
+    ~n_stages microbatches (vs num_microbatches for gpipe) — the
+    PipeDream-flush memory bound (reference trainer.h:110 SectionWorker
+    steady state)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    yv = rng.standard_normal((32, 1)).astype(np.float32)
+    M, S = 8, 3
+    peaks = {}
+    for schedule in ("1f1b", "gpipe"):
+        main, startup, loss = _build_3stage(M, schedule)
+        scope = pt.Scope()
+        exe = pt.Executor()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed={"x": x, "y": yv}, fetch_list=[loss.name])
+        plan = main._pipeline
+        peaks[schedule] = plan.last_peak_stash
+        if schedule != "1f1b":
+            continue
+        for s in range(S):
+            seq = [k for (k, ss, _) in plan.last_dispatch if ss == s]
+            w = min(S - 1 - s, M)
+            expect = ["f"] * w + ["f", "b"] * (M - w) + ["b"] * w
+            assert seq == expect, (s, seq)
+        # microbatch order within each stage is sequential
+        for s in range(S):
+            fs = [m for (k, ss, m) in plan.last_dispatch
+                  if ss == s and k == "f"]
+            assert fs == list(range(M))
+    assert peaks["gpipe"] == M, peaks
+    assert peaks["1f1b"] <= S + 1, peaks
+
+
+def test_1f1b_matches_gpipe_and_single_device():
+    single, single_params, _ = _run(pipeline=False)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    yv = rng.standard_normal((32, 1)).astype(np.float32)
+    results = {}
+    for schedule in ("1f1b", "gpipe"):
+        main, startup, loss = _build_3stage(8, schedule)
+        scope = pt.Scope()
+        exe = pt.Executor()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            hist = []
+            for _ in range(5):
+                (lv,) = exe.run(main, feed={"x": x, "y": yv},
+                                fetch_list=[loss.name])
+                hist.append(float(np.asarray(lv).reshape(-1)[0]))
+            results[schedule] = (
+                hist, {p.name: np.asarray(scope.find_var(p.name))
+                       for p in main.all_parameters()})
+    h1, p1 = results["1f1b"]
+    h2, p2 = results["gpipe"]
+    np.testing.assert_allclose(h1, h2, rtol=1e-5, atol=1e-6)
+    for n in p1:
+        np.testing.assert_allclose(p1[n], p2[n], rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_dropout_backward_replays_forward_masks():
+    """The backward replay must apply the SAME dropout masks the forward
+    drew (r4 weak #5: re-drawn masks make pipeline+dropout a biased
+    estimator). Oracle: loss = mean(dropout(x @ W)); the realized mask is
+    recoverable from the fetched dropout output, so the exact analytic
+    dW is computable and must equal the pipeline's applied update."""
+    lr, M = 0.05, 4
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 13
+    startup.random_seed = 13
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[16], dtype="float32")
+            h = L.fc(x, size=8, bias_attr=False)   # stage 0
+            d = L.dropout(h, dropout_prob=0.5)     # stage 1
+            loss = L.mean(d)
+            from paddle_tpu.parallel.pipeline import build_pipeline_plan
+            main._pipeline = build_pipeline_plan(
+                main, loss, [h], pt.optimizer.SGD(lr), M, startup,
+                schedule="1f1b")
+    scope = pt.Scope()
+    exe = pt.Executor()
+    rng = np.random.default_rng(5)
+    xv = rng.standard_normal((32, 16)).astype(np.float32)
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        wname = main.all_parameters()[0].name
+        w0 = np.asarray(scope.find_var(wname)).copy()
+        outs = exe.run(main, feed={"x": xv},
+                       fetch_list=[loss.name, d.name])
+        w1 = np.asarray(scope.find_var(wname))
+    dv = np.asarray(outs[1])            # realized dropout output [32, 8]
+    assert dv.shape == (32, 8)
+    # fluid default downgrade_in_infer: train out = h * mask (no upscale)
+    mask = (dv != 0).astype(np.float32)
+    # some units must actually have dropped for the test to mean anything
+    assert 0 < mask.mean() < 1
+    dW = xv.T @ (mask / dv.size)        # d mean(h*mask) / dW
+    np.testing.assert_allclose(w1, w0 - lr * dW, rtol=1e-4, atol=1e-5)
