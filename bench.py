@@ -267,13 +267,18 @@ def bench_wmt(on_tpu: bool, peak: float):
         exe.run(main_p, feed=feed)
         assert pt.global_scope().find_var(drain) is not None, drain
         np.asarray(pt.global_scope().find_var(drain))
-        dt = float("inf")
-        for _ in range(2):  # best-of-2 (one-sided interference, PERF r4)
+        # 3 windows with the spread recorded (VERDICT r4 #9: the WMT margin
+        # is one interference burst from red, and its bimodality is
+        # documented — more, shorter windows dodge single bursts and the
+        # recorded spread distinguishes outliers from regressions)
+        windows = []
+        for _ in range(3 if on_tpu else 2):
             t0 = time.perf_counter()
             for _ in range(iters):
                 exe.run(main_p, feed=feed)
             np.asarray(pt.global_scope().find_var(drain))
-            dt = min(dt, (time.perf_counter() - t0) / iters)
+            windows.append((time.perf_counter() - t0) / iters)
+        dt = min(windows)
         (lv,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
         assert np.isfinite(float(np.asarray(lv)))
 
@@ -286,7 +291,8 @@ def bench_wmt(on_tpu: bool, peak: float):
                                    + tgt_len * t_tgt        # dec self (causal)
                                    + src_len * t_tgt))      # cross
     mfu = (step_flops / dt) / peak
-    return (t_src + t_tgt) / dt, mfu
+    wmt_windows = [round((t_src + t_tgt) / w, 1) for w in windows]
+    return (t_src + t_tgt) / dt, mfu, wmt_windows
 
 
 def bench_deepfm(on_tpu: bool):
@@ -380,7 +386,7 @@ def main():
 
     tok_s, bert_mfu = bench_bert(on_tpu, peak)
     img_s, rn_mfu = bench_resnet(on_tpu, peak)
-    wmt_tok_s, wmt_mfu = bench_wmt(on_tpu, peak)
+    wmt_tok_s, wmt_mfu, wmt_windows = bench_wmt(on_tpu, peak)
     ctr_ex_s, ctr_windows = bench_deepfm(on_tpu)
     long_ctx = bench_bert_long(on_tpu)
 
@@ -417,6 +423,7 @@ def main():
         "resnet50_images_per_sec_per_chip": round(img_s, 2),
         "resnet50_mfu": round(rn_mfu, 4),
         "transformer_wmt_tokens_per_sec_per_chip": round(wmt_tok_s, 2),
+        "transformer_wmt_windows_tok_s": wmt_windows,
         "transformer_wmt_mfu": round(wmt_mfu, 4),
         "deepfm_examples_per_sec": round(ctr_ex_s, 2),
         "deepfm_windows_ex_s": ctr_windows,

@@ -29,7 +29,6 @@ black_list = {
     "sum",
     "cos_sim",
     "log_softmax",
-    "softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits",
     "cross_entropy",
     "reduce_sum",
@@ -50,6 +49,10 @@ gray_list = {
     "unsqueeze2", "stack", "scale", "lookup_table", "lookup_table_v2",
     "layer_norm", "softmax", "softmax_mask_fuse_upper_triangle",
     "batch_norm",
+    # gray since r5: the op upcasts to fp32 INTERNALLY (classic path) or
+    # keeps fp32 statistics in-kernel (Pallas path) — black-listing it
+    # doubled the lm-head logits traffic at BERT vocab sizes
+    "softmax_with_cross_entropy",
 }
 
 

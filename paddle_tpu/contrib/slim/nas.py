@@ -67,7 +67,13 @@ class SAController:
         for _ in range(1000):
             tokens = list(self._tokens)
             pos = int(self._rng.integers(len(tokens)))
-            tokens[pos] = int(self._rng.integers(self._range_table[pos]))
+            # reference offset-mod formula: the mutation ALWAYS lands on a
+            # different value, so no trial evaluates an unchanged
+            # architecture (ADVICE r4; degenerate range 1 keeps the value)
+            r = self._range_table[pos]
+            if r > 1:
+                tokens[pos] = (tokens[pos]
+                               + int(self._rng.integers(r - 1)) + 1) % r
             if self._constrain_func is None or self._constrain_func(tokens):
                 return tokens
         raise RuntimeError("SAController: constraint rejected 1000 "

@@ -20,6 +20,10 @@ from . import flowers  # noqa: F401
 from . import conll05  # noqa: F401
 from . import sentiment  # noqa: F401
 from . import imikolov  # noqa: F401
+from . import image  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import voc2012  # noqa: F401
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "wmt14", "wmt16",
-           "movielens", "flowers", "conll05", "sentiment", "imikolov"]
+           "movielens", "flowers", "conll05", "sentiment", "imikolov",
+           "image", "mq2007", "voc2012"]

@@ -66,8 +66,13 @@ def _real(split):
             if idx not in wanted:
                 continue
             img = Image.open(io.BytesIO(tf.extractfile(m).read()))
-            img = img.convert("RGB").resize((224, 224))
-            arr = (np.asarray(img, np.float32) / 255.0).transpose(2, 0, 1)
+            # the reference pipeline: resize_short(256) -> center_crop(224)
+            # -> CHW (paddle.dataset.image.simple_transform)
+            from . import image as img_utils
+
+            arr = img_utils.simple_transform(
+                np.asarray(img.convert("RGB")), 256, 224,
+                is_train=False) / 255.0
             yield arr.reshape(-1), int(labels[idx - 1]) - 1
 
 

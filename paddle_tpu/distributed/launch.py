@@ -89,8 +89,11 @@ def launch_ps(args) -> int:
     n_workers = args.worker_num or 1
     if args.servers:
         server_eps = [e for e in args.servers.split(",") if e]
-        if len(server_eps) != n_servers and args.server_num:
-            n_servers = len(server_eps)
+        if args.server_num and len(server_eps) != args.server_num:
+            raise ValueError(
+                f"--servers lists {len(server_eps)} endpoints but "
+                f"--server_num={args.server_num}; drop one or make them "
+                "agree (one local pserver process is spawned per endpoint)")
     else:
         server_eps = [f"{args.node_ip}:{_free_port()}"
                       for _ in range(n_servers)]

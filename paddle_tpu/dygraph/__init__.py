@@ -43,6 +43,14 @@ __all__ = [
     "Embedding",
     "BatchNorm",
     "LayerNorm",
+    "GRUUnit",
+    "NCE",
+    "PRelu",
+    "BilinearTensorProduct",
+    "GroupNorm",
+    "SpectralNorm",
+    "Conv3D",
+    "Conv3DTranspose",
 ]
 
 _state = {"enabled": False, "tape": None, "no_grad": 0, "rng": None}
@@ -612,6 +620,207 @@ class LayerNorm(Layer):
             {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
             attrs={"epsilon": self._eps,
                    "begin_norm_axis": len(x.shape) - 1})["Y"]
+
+
+class GRUUnit(Layer):
+    """reference dygraph/nn.py:1411 GRUUnit — one GRU step over the
+    pre-projected input. forward(input [B,3H], hidden [B,H]) returns
+    (updated_hidden, reset_hidden_pre, gate) like the reference (:1561)."""
+
+    def __init__(self, size, activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__()
+        H = size // 3
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([H, 3 * H], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([1, 3 * H], dtype, is_bias=True))
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation,
+                       "origin_mode": origin_mode}
+
+    def forward(self, input: VarBase, hidden: VarBase):
+        outs = _dy_op("gru_unit",
+                      {"Input": [input], "HiddenPrev": [hidden],
+                       "Weight": [self.weight], "Bias": [self.bias]},
+                      attrs=dict(self._attrs))
+        return outs["Hidden"], outs["ResetHiddenPrev"], outs["Gate"]
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py NCE — noise-contrastive estimation head.
+    forward(input [B,D], label [B,1]) -> Cost [B,1]."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=5,
+                 sampler="uniform", dtype="float32"):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([num_total_classes, dim], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_total_classes], dtype,
+                                          is_bias=True))
+        self._attrs = {
+            "num_total_classes": num_total_classes,
+            "num_neg_samples": num_neg_samples,
+            "sampler": {"uniform": 0, "log_uniform": 1}.get(sampler, 0),
+        }
+
+    def forward(self, input: VarBase, label: VarBase) -> VarBase:
+        return _dy_op("nce",
+                      {"Input": [input], "Label": [label],
+                       "Weight": [self.weight], "Bias": [self.bias]},
+                      attrs=dict(self._attrs))["Cost"]
+
+
+class PRelu(Layer):
+    """reference dygraph/nn.py PRelu. mode: all | channel | element;
+    channel_or_shape: channel count for 'channel', full feature shape for
+    'element' (ignored for 'all')."""
+
+    def __init__(self, mode="all", channel_or_shape=None, dtype="float32"):
+        super().__init__()
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [int(channel_or_shape)]
+        elif mode == "element":
+            shape = list(channel_or_shape)
+        else:
+            raise ValueError(f"unknown PRelu mode '{mode}'")
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                shape, dtype, default_initializer=_const_init(0.25)))
+        self._mode = mode
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _dy_op("prelu", {"X": [x], "Alpha": [self.weight]},
+                      attrs={"mode": self._mode})["Out"]
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct:
+    out[b,k] = x[b] W[k] y[b] + bias[k]."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, dtype="float32"):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [output_dim, input1_dim, input2_dim], dtype))
+        # bias [1, size] for reference checkpoint-shape parity
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([1, output_dim], dtype,
+                                          is_bias=True))
+
+    def forward(self, x: VarBase, y: VarBase) -> VarBase:
+        return _dy_op("bilinear_tensor_product",
+                      {"X": [x], "Y": [y], "Weight": [self.weight],
+                       "Bias": [self.bias]})["Out"]
+
+
+class GroupNorm(Layer):
+    """reference dygraph/nn.py GroupNorm (NCHW)."""
+
+    def __init__(self, channels, groups, epsilon=1e-5, dtype="float32"):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [channels], dtype, default_initializer=_const_init(1.0)))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([channels], dtype, is_bias=True))
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _dy_op("group_norm",
+                      {"X": [x], "Scale": [self.weight],
+                       "Bias": [self.bias]},
+                      attrs=dict(self._attrs))["Y"]
+
+
+class SpectralNorm(Layer):
+    """reference dygraph/nn.py:2548 SpectralNorm: forward(weight) returns
+    weight / sigma_max via power iteration; U/V persist across calls as
+    non-trainable state (updated in place like BatchNorm running stats)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.default_rng(0)
+        self._u = VarBase(rng.standard_normal(h).astype(np_dtype(dtype)),
+                          stop_gradient=True)
+        self._v = VarBase(rng.standard_normal(w).astype(np_dtype(dtype)),
+                          stop_gradient=True)
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+
+    def forward(self, weight: VarBase) -> VarBase:
+        outs = _dy_op("spectral_norm",
+                      {"Weight": [weight], "U": [self._u], "V": [self._v]},
+                      attrs=dict(self._attrs))
+        if outs.get("UOut") is not None:
+            self._u._value = outs["UOut"]._value
+        if outs.get("VOut") is not None:
+            self._v._value = outs["VOut"]._value
+        return outs["Out"]
+
+
+class Conv3D(Layer):
+    """reference dygraph/nn.py Conv3D (NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, groups=1, act=None, dtype="float32"):
+        super().__init__()
+        k = (filter_size if isinstance(filter_size, (tuple, list))
+             else (filter_size,) * 3)
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [num_filters, num_channels // groups, *k], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], dtype, is_bias=True))
+        _3 = lambda v: list(v) if isinstance(v, (tuple, list)) else [v] * 3
+        self._attrs = {"strides": _3(stride), "paddings": _3(padding),
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = _dy_op("conv3d", {"Input": [x], "Filter": [self.weight]},
+                     attrs=dict(self._attrs))["Output"]
+        bias = _dy_op("reshape2", {"X": [self.bias]},
+                      attrs={"shape": [1, -1, 1, 1, 1]})["Out"]
+        out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """reference dygraph/nn.py Conv3DTranspose (NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, groups=1, act=None, dtype="float32"):
+        super().__init__()
+        k = (filter_size if isinstance(filter_size, (tuple, list))
+             else (filter_size,) * 3)
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [num_channels, num_filters // groups, *k], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], dtype, is_bias=True))
+        _3 = lambda v: list(v) if isinstance(v, (tuple, list)) else [v] * 3
+        self._attrs = {"strides": _3(stride), "paddings": _3(padding),
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = _dy_op("conv3d_transpose",
+                     {"Input": [x], "Filter": [self.weight]},
+                     attrs=dict(self._attrs))["Output"]
+        bias = _dy_op("reshape2", {"X": [self.bias]},
+                      attrs={"shape": [1, -1, 1, 1, 1]})["Out"]
+        out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
 
 
 def _const_init(v):

@@ -54,6 +54,11 @@ def all_flags() -> dict:
 
 
 # -- declarations ------------------------------------------------------------
+_define("pallas_xent", False,
+        "route large-vocab hard-label softmax_with_cross_entropy through "
+        "the Pallas TPU kernel (ops/pallas_kernels/xent.py). Default OFF: "
+        "measured 8.5% SLOWER end-to-end than XLA's in-model fusion at "
+        "BERT shapes (PERF.md r5) — kept as a measured-and-retired lever")
 _define("check_nan_inf", False,
         "run eagerly and validate every op's floating outputs are finite, "
         "raising with op attribution (reference operator.cc:949)")

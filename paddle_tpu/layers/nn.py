@@ -1973,7 +1973,14 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
          dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
          default_initializer=None, seed=-1):
     """reference nn.py lstm (the cudnn_lstm path): stacked/bidirectional
-    LSTM over [B, T, D]. Returns (rnn_out, last_h, last_c)."""
+    LSTM over [B, T, D]. Returns (rnn_out, last_h, last_c).
+
+    Deliberate layout divergence from the reference's cudnn flat-weight
+    blob: ONE 4H bias per layer/direction is packed instead of cudnn's two
+    (b_ih + b_hh, 8H). The cell only ever uses their SUM, so expressiveness
+    is identical, but the flat W numel differs — reference-trained
+    cudnn_lstm checkpoints cannot be loaded into this layer directly
+    (fold b_ih+b_hh into one bias when converting). ADVICE r4."""
     helper = LayerHelper("cudnn_lstm", name=name)
     dtype = input.dtype
     D = input.shape[-1]
@@ -2175,6 +2182,15 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
     """reference nn.py im2sequence: sliding-window im2col. Padded design
     returns [B, n_windows, C*kh*kw] (the reference flattens the batch into
     the LoD)."""
+    if input_image_size is not None or (
+            out_stride != 1 and out_stride != [1, 1]):
+        # the reference uses these for per-image real-size window counts
+        # (im2sequence_op.cc batch-LoD path); silently ignoring them would
+        # return wrong window counts — refuse like dynamic_lstmp peepholes
+        raise NotImplementedError(
+            "im2sequence: input_image_size/out_stride (per-image real-size "
+            "windows) are not supported on the padded XLA design")
+
     def _pair(v, n=2):
         return list(v) if isinstance(v, (list, tuple)) else [v] * n
 

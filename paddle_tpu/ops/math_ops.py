@@ -348,27 +348,44 @@ def center_loss(ctx: ExecContext):
 
 @register_op("teacher_student_sigmoid_loss")
 def teacher_student_sigmoid_loss(ctx: ExecContext):
-    """reference teacher_student_sigmoid_loss_op.cc: distillation CTR loss.
-    With z the logit and label carrying teacher score (label > 1 or < -1
-    bounds clip via soft_max_up/lower_bound):
-      y < -1:  log(1+exp(z)) - z*label_part ... (the reference's piecewise)
-    Faithful piecewise port of the CPU kernel."""
-    x = ctx.input("X").reshape(-1).astype(jnp.float32)
+    """reference teacher_student_sigmoid_loss_op.h: distillation CTR loss.
+    label encodes click + optional teacher score q in {-2, -1, [0, 2]}:
+      no q, clk=0: label = -2    ->  y = softplus(x)
+      no q, clk=1: label = -1    ->  y = softplus(x) - x
+      q,   clk=0: label = q      ->  y = 2*softplus(x) - x*label
+      q,   clk=1: label = 1 + q  ->  y = 2*softplus(x) - x*label
+    (the kernel's label>=1 branch softplus-x + softplus-x*(label-1) is
+    algebraically the same 2*softplus(x) - x*label). The FORWARD is
+    unclipped; soft_max_up/lower_bound clip only the BACKWARD's sigmoid
+    argument, with dX zeroed at saturation (grad kernel :95-111)."""
+    x_in = ctx.input("X")
     label = ctx.input("Label").reshape(-1).astype(jnp.float32)
     up = float(ctx.attr("soft_max_up_bound", 15.0))
     lo = float(ctx.attr("soft_max_lower_bound", -15.0))
-    z = jnp.clip(x, lo, up)
-    softplus = jnp.logaddexp(0.0, z)
-    # reference kernel: label == -1 -> teacher-only; label in {0,1} hard CTR
-    # term; else combined (teacher score s = label - ceil(label) trick).
-    # The shipped CPU kernel reduces to:
-    #   loss = (z>=0 ? z : 0) - z*hard + log(1+exp(-|z|))  [hard part]
-    #        + teacher part when the teacher score is embedded in label
-    hard = jnp.where(label > 0.5, 1.0, 0.0)
-    ce = jnp.maximum(z, 0.0) - z * hard + jnp.log1p(jnp.exp(-jnp.abs(z)))
-    loss = jnp.where(jnp.abs(label) <= 1.0, ce,
-                     softplus - z * (jnp.abs(label) - 1.0))
-    return {"Y": loss.reshape(-1, 1).astype(ctx.input("X").dtype)}
+
+    @jax.custom_vjp
+    def _loss(x, label):
+        sp = jnp.logaddexp(0.0, x)
+        return jnp.where(
+            label < -1.0, sp,
+            jnp.where(label < 0.0, sp - x, 2.0 * sp - x * label))
+
+    def _fwd(x, label):
+        return _loss(x, label), (x, label)
+
+    def _bwd(res, dy):
+        x, label = res
+        z = jnp.clip(x, lo, up)
+        pred = jax.nn.sigmoid(z)
+        dydx = jnp.where(label < -1.0, pred,
+                         jnp.where(label < 0.0, pred - 1.0,
+                                   2.0 * pred - label))
+        dydx = jnp.where((x >= up) | (x <= lo), 0.0, dydx)
+        return (dydx * dy, jnp.zeros_like(label))
+
+    _loss.defvjp(_fwd, _bwd)
+    y = _loss(x_in.reshape(-1).astype(jnp.float32), label)
+    return {"Y": y.reshape(-1, 1).astype(x_in.dtype)}
 
 
 @register_op("cross_entropy2")

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags
 from .registry import ExecContext, register_op, register_grad_compute
 
 
@@ -162,21 +163,56 @@ def cross_entropy(ctx: ExecContext):
     }
 
 
+def _xent_pallas_eligible(logits, soft, ignore) -> bool:
+    """Large-vocab hard-label xent on TPU routes to the fused Pallas kernel
+    (pallas_kernels/xent.py): the fwd never materializes the softmax and
+    the bwd recomputes stats in-VMEM — one logits read fwd, one read + one
+    dlogits write bwd."""
+    if soft or ignore >= 0 or not flags.get_flag("pallas_xent"):
+        return False  # flag off (the default): never pay the pallas import
+    from .pallas_kernels import xent as px
+
+    if not (px.INTERPRET or jax.default_backend() in ("tpu", "axon")):
+        return False
+    n = int(np.prod(logits.shape[:-1]))
+    return px.xent_supported((n, logits.shape[-1]), logits.shape[-1],
+                             dtype=logits.dtype)
+
+
 @register_op("softmax_with_cross_entropy")
 def softmax_with_cross_entropy(ctx: ExecContext):
     logits, label = ctx.input("Logits"), ctx.input("Label")
     soft = ctx.attr("soft_label", False)
     ignore = ctx.attr("ignore_index", -100)
-    lsm = jax.nn.log_softmax(logits, axis=-1)
-    sm = jnp.exp(lsm)
+    if _xent_pallas_eligible(logits, soft, ignore):
+        from .pallas_kernels import xent as px
+
+        lbl = (label.reshape(label.shape[:-1])
+               if label.shape[-1] == 1 else label)
+        n = int(np.prod(logits.shape[:-1]))
+        loss = px.xent_loss_fwd(logits.reshape(n, logits.shape[-1]),
+                                lbl.reshape(n).astype(jnp.int32))
+        loss = loss.reshape(*logits.shape[:-1], 1).astype(logits.dtype)
+        # Softmax output as a PLAIN jnp expression: dead-code-eliminated by
+        # XLA when nothing consumes it (the usual case — the pallas grad
+        # branch below recomputes instead of reading it), exact when a user
+        # fetches it.
+        sm = jax.nn.softmax(logits.astype(jnp.float32),
+                            axis=-1).astype(logits.dtype)
+        return {"Softmax": sm, "Loss": loss}
+    # fp32 statistics INTERNALLY (gray-listed under AMP): bf16 in/out,
+    # fp32 softmax math — the layer_norm/batch_norm discipline
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    sm = jnp.exp(lsm).astype(logits.dtype)
     if soft:
-        loss = -jnp.sum(label * lsm, axis=-1, keepdims=True)
+        loss = -jnp.sum(label.astype(jnp.float32) * lsm, axis=-1,
+                        keepdims=True)
     else:
         lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
         loss = -jnp.take_along_axis(lsm, lbl[..., None].astype(np.int32), axis=-1)
         if ignore >= 0:
             loss = jnp.where(lbl[..., None] == ignore, jnp.zeros_like(loss), loss)
-    return {"Softmax": sm, "Loss": loss}
+    return {"Softmax": sm, "Loss": loss.astype(logits.dtype)}
 
 
 @register_grad_compute("softmax_with_cross_entropy")
@@ -186,7 +222,23 @@ def softmax_with_cross_entropy_grad(ctx: ExecContext):
     sm = ctx.input("Softmax")
     label = ctx.input("Label")
     dloss = ctx.input("Loss@GRAD")
-    if ctx.attr("soft_label", False):
+    soft = ctx.attr("soft_label", False)
+    logits = ctx.input("Logits")
+    if (logits is not None
+            and _xent_pallas_eligible(logits, soft,
+                                      ctx.attr("ignore_index", -100))):
+        # same predicate as the forward: recompute stats in-VMEM from the
+        # logits instead of reading the (never-materialized) softmax
+        from .pallas_kernels import xent as px
+
+        lbl = (label.reshape(label.shape[:-1])
+               if label.shape[-1] == 1 else label)
+        n = int(np.prod(logits.shape[:-1]))
+        dx = px.xent_grad(logits.reshape(n, logits.shape[-1]),
+                          lbl.reshape(n).astype(jnp.int32),
+                          dloss.reshape(n))
+        return {"Logits@GRAD": dx.reshape(logits.shape)}
+    if soft:
         grad = (sm - label) * dloss
     else:
         lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
@@ -209,6 +261,9 @@ def softmax_with_cross_entropy_grad_maker(op, block, no_grad_set=frozenset()):
             "type": "softmax_with_cross_entropy_grad",
             "inputs": {
                 "Softmax": op.output("Softmax"),
+                # Logits feed the Pallas fast path's in-VMEM stat recompute;
+                # the classic path ignores them
+                "Logits": op.input("Logits"),
                 "Label": op.input("Label"),
                 "Loss@GRAD": [grad_var_name(op.output("Loss")[0])],
             },

@@ -1,0 +1,220 @@
+"""Fused softmax-cross-entropy over a large vocab: a Pallas TPU kernel.
+
+STATUS: measured and RETIRED (PERF.md r5, FLAGS_pallas_xent default off).
+Isolated chained microbenchmarks suggested XLA ran the lm-head xent at
+~55% of the HBM roofline, but the harness's own chain-add costs ~7 ms per
+1 GB iteration and FUSES into the op under value_and_grad, poisoning every
+isolated number. The decisive experiment is end-to-end: BERT-base b128
+s128 measures 166.9k tok/s with XLA's fused path vs 152.8k tok/s with
+this kernel (-8.5%) — XLA's cross-op fusion (lm-head matmul epilogue +
+xent + weighted-mean consumer) beats the opaque pallas_call boundary,
+the same verdict as the r4 conv-chain lever. Kept (default-off) as the
+measured artifact and for interpreter-mode regression coverage.
+
+Design:
+  * grid over row tiles [TN, Vp]: one DMA of the tile; max, sum-exp, and
+    the label pick (one-hot select, VMEM-local) in a single visit; only
+    per-row loss/max/lse [TN] leave the chip.
+  * vocab padded to a lane multiple by the CALLER (jnp.pad fuses into the
+    producing matmul's epilogue); the kernel masks padding columns by
+    index, so pad values are irrelevant.
+  * backward recomputes p = exp(x - m - lse) from the saved [TN] stats —
+    one read of logits, one write of dlogits, no other residuals.
+
+Reference role: replaces softmax_with_cross_entropy
+(reference softmax_with_cross_entropy_op.* fused CUDA kernel) on the TPU
+hot path for 2-D hard-label calls.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# tests flip this to run through the Pallas interpreter on CPU
+INTERPRET = False
+
+_VC = 2048  # inner vocab chunk: fp32 temporaries are [TN, VC] so the
+# ~16 MB scoped-VMEM limit holds; the block is visited chunkwise
+
+
+def _tn(dtype, bwd=False) -> int:
+    """Row tile sized for the ~16 MB scoped-VMEM limit: the fwd holds one
+    double-buffered [TN, Vp] block; the bwd holds an input AND an output
+    block, so it halves the tile."""
+    tn = 64 if jnp.dtype(dtype).itemsize <= 2 else 32
+    return tn // 2 if bwd else tn
+
+
+def xent_supported(logits_shape, vocab_real: int, dtype=jnp.bfloat16) -> bool:
+    n, v = logits_shape
+    return n % 64 == 0 and v >= 512  # 64 covers every tile choice
+
+
+def _chunks(vp):
+    return [(j, min(_VC, vp - j)) for j in range(0, vp, _VC)]
+
+
+def _fwd_kernel(x_ref, lab_ref, loss_ref, m_ref, lse_ref, *, v_real):
+    tn, vp = x_ref.shape
+    lab = lab_ref[...].reshape(tn)                           # [TN] int32
+    # online softmax over vocab chunks: fp32 temporaries stay [TN, VC]
+    m = jnp.full((tn,), -jnp.inf, jnp.float32)
+    s = jnp.zeros((tn,), jnp.float32)
+    picked = jnp.zeros((tn,), jnp.float32)
+    # padding columns carry -1e30 (the caller pads): exp underflows to 0
+    # and max ignores them, so no per-chunk index masking is needed
+    for j, w in _chunks(vp):
+        xj = x_ref[:, j:j + w].astype(jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (tn, w), 1) + j
+        mj = jnp.max(xj, axis=1)
+        m_new = jnp.maximum(m, mj)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(xj - m_new[:, None]), axis=1)
+        m = m_new
+        picked = picked + jnp.sum(
+            jnp.where(col == lab[:, None], xj, 0.0), axis=1)
+    lse = jnp.log(s)
+    loss_ref[...] = (-(picked - m - lse))[:, None]
+    m_ref[...] = m[:, None]
+    lse_ref[...] = lse[:, None]
+
+
+def _bwd_kernel(x_ref, lab_ref, m_ref, lse_ref, g_ref, dx_ref, *, v_real):
+    tn, vp = x_ref.shape
+    m = m_ref[...].reshape(tn)
+    lse = lse_ref[...].reshape(tn)
+    lab = lab_ref[...].reshape(tn)
+    g = g_ref[...].reshape(tn)
+    for j, w in _chunks(vp):
+        xj = x_ref[:, j:j + w].astype(jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (tn, w), 1) + j
+        # pad cols: xj = -1e30 -> p = 0; labels < v_real so onehot is 0
+        p = jnp.exp(xj - (m + lse)[:, None])
+        onehot = col == lab[:, None]
+        dx_ref[:, j:j + w] = ((p - onehot.astype(jnp.float32))
+                              * g[:, None]).astype(dx_ref.dtype)
+
+
+def _pad_to_lanes(logits):
+    n, v = logits.shape
+    vp = (v + 127) // 128 * 128
+    if vp != v:
+        # -1e30: padding behaves as "never the max, exp == 0" so the
+        # kernels need no per-chunk index masking (VPU cost, PERF r5)
+        logits = jnp.pad(logits, ((0, 0), (0, vp - v)),
+                         constant_values=-1e30)
+    return logits, vp
+
+
+def _run_fwd(logits, labels, interpret):
+    n, v = logits.shape
+    xp, vp = _pad_to_lanes(logits)
+    tn = _tn(logits.dtype)
+    grid = (n // tn,)
+    loss, m, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, v_real=v),
+        grid=grid,
+        in_specs=[pl.BlockSpec((tn, vp), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((tn, 1), lambda i: (i, 0))] * 3,
+        out_shape=[jax.ShapeDtypeStruct((n, 1), jnp.float32)] * 3,
+        interpret=interpret,
+    )(xp, labels.reshape(n, 1).astype(jnp.int32))
+    return loss[:, 0], m, lse
+
+
+def _run_bwd(logits, labels, m, lse, g, interpret):
+    n, v = logits.shape
+    xp, vp = _pad_to_lanes(logits)
+    tn = _tn(logits.dtype, bwd=True)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, v_real=v),
+        grid=(n // tn,),
+        in_specs=[pl.BlockSpec((tn, vp), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tn, vp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, vp), logits.dtype),
+        interpret=interpret,
+    )(xp, labels.reshape(n, 1).astype(jnp.int32), m, lse,
+      g.reshape(n, 1))
+    return dx[:, :v]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_xent_rows(logits, labels, interpret=False):
+    """Per-row hard-label cross entropy: [N, V] x [N] -> loss [N] fp32.
+    Gradients flow to logits only."""
+    loss, _, _ = _run_fwd(logits, labels, interpret or INTERPRET)
+    return loss
+
+
+def _vjp_fwd(logits, labels, interpret):
+    loss, m, lse = _run_fwd(logits, labels, interpret or INTERPRET)
+    return loss, (logits, labels, m, lse)
+
+
+def _vjp_bwd(interpret, res, g):
+    logits, labels, m, lse = res
+    dx = _run_bwd(logits, labels, m, lse, g.astype(jnp.float32),
+                  interpret or INTERPRET)
+    return dx, None
+
+
+softmax_xent_rows.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def _bwd_kernel_nostats(x_ref, lab_ref, g_ref, dx_ref, *, v_real):
+    """dx without saved stats: the block is VMEM-resident, so m/lse are
+    recomputed chunkwise with NO extra HBM traffic (one read, one write)."""
+    tn, vp = x_ref.shape
+    lab = lab_ref[...].reshape(tn)
+    g = g_ref[...].reshape(tn)
+    m = jnp.full((tn,), -jnp.inf, jnp.float32)
+    s = jnp.zeros((tn,), jnp.float32)
+    for j, w in _chunks(vp):
+        xj = x_ref[:, j:j + w].astype(jnp.float32)
+        mj = jnp.max(xj, axis=1)
+        m_new = jnp.maximum(m, mj)
+        s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(xj - m_new[:, None]),
+                                             axis=1)
+        m = m_new
+    mlse = m + jnp.log(s)
+    for j, w in _chunks(vp):
+        xj = x_ref[:, j:j + w].astype(jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (tn, w), 1) + j
+        p = jnp.exp(xj - mlse[:, None])
+        onehot = col == lab[:, None]
+        dx_ref[:, j:j + w] = ((p - onehot.astype(jnp.float32))
+                              * g[:, None]).astype(dx_ref.dtype)
+
+
+def xent_loss_fwd(logits, labels, interpret=False):
+    """Program-op forward: per-row loss only (no saved stats — the
+    program-level grad op recomputes them in-kernel)."""
+    loss, _, _ = _run_fwd(logits, labels, interpret or INTERPRET)
+    return loss
+
+
+def xent_grad(logits, labels, g, interpret=False):
+    """Program-op backward: dlogits from logits + labels + per-row dloss."""
+    n, v = logits.shape
+    xp, vp = _pad_to_lanes(logits)
+    tn = _tn(logits.dtype, bwd=True)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel_nostats, v_real=v),
+        grid=(n // tn,),
+        in_specs=[pl.BlockSpec((tn, vp), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((tn, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tn, vp), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, vp), logits.dtype),
+        interpret=interpret or INTERPRET,
+    )(xp, labels.reshape(n, 1).astype(jnp.int32),
+      g.astype(jnp.float32).reshape(n, 1))
+    return dx[:, :v]

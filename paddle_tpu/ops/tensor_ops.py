@@ -448,11 +448,19 @@ def hash_op(ctx: ExecContext):
     x = ctx.input("X")
     num_hash = int(ctx.attr("num_hash", 1))
     mod_by = int(ctx.attr("mod_by", 100000))
-    v = x.astype(jnp.uint32)
+    # BOTH 32-bit halves of the int64 id must participate (the reference
+    # xxhashes all 8 id bytes): truncating to uint32 collides every pair of
+    # ids differing only above bit 31 in ALL buckets (ADVICE r4)
+    lo32 = x.astype(jnp.uint32)  # wraps mod 2^32 == low half
+    if jnp.dtype(x.dtype).itemsize >= 8:  # true 64-bit ids (x64 enabled)
+        hi32 = (x >> 32).astype(jnp.uint32)
+    else:  # x32 mode: ids are 32-bit on device; no upper half exists
+        hi32 = jnp.zeros_like(lo32)
     outs = []
     for seed in range(num_hash):
-        h = v ^ jnp.uint32((0x9E3779B9 * (seed + 1)) & 0xFFFFFFFF)
+        h = lo32 ^ jnp.uint32((0x9E3779B9 * (seed + 1)) & 0xFFFFFFFF)
         h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = h ^ (hi32 * jnp.uint32(0x27D4EB2F))  # fold in the upper half
         h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
         h = h ^ (h >> 16)
         # fold the last-dim id vector into ONE bucket per row (the

@@ -501,6 +501,11 @@ class PipelinePlan:
             live = sum(1 for d in stash if d)
             self.last_peak_stash = max(self.last_peak_stash, live)
 
+        # boundary shapes recorded at forward time: the backward's
+        # zero-cotangent fallback needs them AFTER the stash entry may
+        # already be freed by a lower consumer stage (r5 review fix)
+        shape_of: dict[str, tuple] = {}
+
         def _fwd_one(s, m, stash, fetched):
             stage = self.stages[s]
             wanted = list(stage.out_names) + [
@@ -520,6 +525,8 @@ class PipelinePlan:
             for n, v in zip(wanted, outs):
                 if n in stage.out_names:
                     stash[m][n] = v
+                    shape_of[n] = tuple(np.asarray(v).shape) \
+                        if not hasattr(v, "shape") else tuple(v.shape)
                 if n in fetched:
                     fetched[n].append(v)
             _note_peak(stash)
@@ -538,10 +545,8 @@ class PipelinePlan:
             for n in stage.out_names:
                 g = grad_stash[m].get(n)
                 if g is None:
-                    ov = stage.fwd.global_block.var(n)
-                    shape = [d if d != -1 else _infer_batch(stash[m][n])
-                             for d in ov.shape]
-                    g = np.zeros(shape, ov.np_dtype)
+                    g = np.zeros(shape_of[n],
+                                 stage.fwd.global_block.var(n).np_dtype)
                 f[n + _GRAD_IN_SUFFIX] = self._to_dev(g, devs[s])
             outs = exe.run(self._stage_prog(s, "bwd"), feed=f,
                            fetch_list=wanted, scope=scope,

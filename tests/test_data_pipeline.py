@@ -195,3 +195,60 @@ def test_new_datasets_yield_contract_tuples():
     # so use an n above the synthetic max sentence length
     src, trg = next(iter(imikolov.train(wd, 40, imikolov.DataType.SEQ)()))
     assert trg[:-1] == src[1:]
+
+
+def test_image_preprocessing_utils():
+    """paddle.dataset.image parity (reference image.py:197-327): numpy-native
+    resize_short/center_crop/random_crop/to_chw/flip/simple_transform."""
+    from paddle_tpu.dataset import image as img
+
+    rng = np.random.default_rng(0)
+    im = rng.integers(0, 255, (120, 80, 3), dtype=np.uint8)
+    r = img.resize_short(im, 64)
+    assert min(r.shape[:2]) == 64 and r.shape[0] == 96  # aspect preserved
+    assert r.dtype == np.uint8
+    # constant image stays constant under bilinear resampling
+    const = np.full((50, 100, 3), 77, np.uint8)
+    rc = img.resize_short(const, 30)
+    assert rc.shape[:2] == (30, 60) and (rc == 77).all()
+
+    c = img.center_crop(r, 48)
+    assert c.shape == (48, 48, 3)
+    np.testing.assert_array_equal(
+        c, r[(96 - 48) // 2:(96 + 48) // 2, (64 - 48) // 2:(64 + 48) // 2])
+    rcu = img.random_crop(r, 48)
+    assert rcu.shape == (48, 48, 3)
+    chw = img.to_chw(c)
+    assert chw.shape == (3, 48, 48)
+    flipped = img.left_right_flip(c)
+    np.testing.assert_array_equal(flipped[:, 0], c[:, -1])
+
+    out = img.simple_transform(im, 64, 48, is_train=True,
+                               mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 48, 48) and out.dtype == np.float32
+    out2 = img.simple_transform(im, 64, 48, is_train=False)
+    np.testing.assert_allclose(out2, img.to_chw(c).astype(np.float32))
+
+
+def test_mq2007_readers():
+    from paddle_tpu.dataset import mq2007
+
+    f, s = next(iter(mq2007.train("pointwise")()))
+    assert f.shape == (46,) and f.dtype == np.float32 and s.shape == (1,)
+    hi, lo = next(iter(mq2007.train("pairwise")()))
+    assert hi.shape == lo.shape == (46,)
+    labels, feats = next(iter(mq2007.test("listwise")()))
+    assert len(labels) == len(feats) and feats[0].shape == (46,)
+    # LETOR line parsing round-trips
+    q = mq2007.Query.parse("2 qid:10 1:0.5 2:0.25 #docid = GX001")
+    assert (q.relevance_score, q.query_id) == (2, 10)
+    assert q.feature_vector == [0.5, 0.25]
+
+
+def test_voc2012_reader():
+    from paddle_tpu.dataset import voc2012
+
+    img, label = next(iter(voc2012.train()()))
+    assert img.ndim == 3 and img.shape[2] == 3 and img.dtype == np.uint8
+    assert label.shape == img.shape[:2] and label.max() >= 1
+    assert len(list(voc2012.val()())) > 0

@@ -324,9 +324,13 @@ def test_cross_entropy2_matches_log():
         out.reshape(-1), -np.log([0.5, 0.9]), rtol=1e-5)
 
 
-def test_teacher_student_loss_finite_and_hard_case():
-    z = np.array([[2.0], [-3.0], [40.0]], np.float32)
-    lab = np.array([[1.0], [0.0], [1.0]], np.float32)
+def test_teacher_student_loss_reference_branches():
+    """Oracle derived from reference teacher_student_sigmoid_loss_op.h:44-63
+    (label < -1 / [-1,0) / [0,1) / >=1 branches, UNCLIPPED forward) and the
+    grad kernel :95-111 (sigmoid of the clipped logit, zero at saturation).
+    label encoding: {-2: no-q clk0, -1: no-q clk1, q: clk0+q, 1+q: clk1+q}."""
+    z = np.array([[2.0], [-3.0], [0.7], [1.4], [-2.0], [40.0]], np.float32)
+    lab = np.array([[-2.0], [-1.0], [0.3], [1.6], [0.0], [1.0]], np.float32)
 
     def build():
         xv = L.data(name="x", shape=[1], dtype="float32")
@@ -334,10 +338,38 @@ def test_teacher_student_loss_finite_and_hard_case():
         return L.teacher_student_sigmoid_loss(xv, lv)
 
     out, = _run(build, {"x": z, "y": lab})
-    zc = np.clip(z, -15, 15).reshape(-1)
-    hard = lab.reshape(-1)
-    expect = np.maximum(zc, 0) - zc * hard + np.log1p(np.exp(-np.abs(zc)))
-    np.testing.assert_allclose(out.reshape(-1), expect, rtol=1e-5)
+    x = z.reshape(-1).astype(np.float64)
+    l = lab.reshape(-1).astype(np.float64)
+    sp = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+    expect = np.where(l < -1.0, sp,
+                      np.where(l < 0.0, sp - x, 2.0 * sp - x * l))
+    np.testing.assert_allclose(out.reshape(-1), expect, rtol=1e-5, atol=1e-6)
+
+    # gradient: sigmoid of the CLIPPED logit; zero where x saturates the
+    # soft_max bounds (the x=40 row)
+    def build_grad():
+        xv = L.data(name="x", shape=[1], dtype="float32")
+        xv.stop_gradient = False
+        lv = L.data(name="y", shape=[1], dtype="float32")
+        loss = L.reduce_sum(L.teacher_student_sigmoid_loss(xv, lv))
+        from paddle_tpu.backward import gradients
+        (g,) = gradients([loss], [xv])
+        return loss, g.name
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            loss, gname = build_grad()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        (gx,) = exe.run(main, feed={"x": z, "y": lab}, fetch_list=[gname])
+    pred = 1.0 / (1.0 + np.exp(-np.clip(x, -15, 15)))
+    expect_g = np.where(l < -1.0, pred,
+                        np.where(l < 0.0, pred - 1.0, 2.0 * pred - l))
+    expect_g = np.where((x >= 15) | (x <= -15), 0.0, expect_g)
+    np.testing.assert_allclose(np.asarray(gx).reshape(-1), expect_g,
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_sampled_softmax_trains():

@@ -335,3 +335,42 @@ def test_transformer_wmt_src_mask_blocks_padding():
         mod["src_ids"] = s2
         (l1,) = exe.run(main, feed=mod, fetch_list=[avg_loss])
     np.testing.assert_allclose(np.asarray(l0), np.asarray(l1), rtol=1e-6)
+
+
+def test_wmt_fused_label_smooth_matches_dense_form():
+    """The fused label-smooth CE in transformer_wmt is algebraically
+    identical to one_hot -> label_smooth -> soft-label CE."""
+    from paddle_tpu import layers as L
+
+    rng = np.random.default_rng(9)
+    V, B = 50, 6
+    x = rng.standard_normal((B, V)).astype(np.float32) * 2.0
+    lab = rng.integers(0, V, (B,)).astype(np.int64)
+    eps = 0.1
+
+    guard, main, startup = _fresh_programs()
+    with guard:
+        lg = pt.layers.data(name="lg", shape=[V], dtype="float32")
+        lb = pt.layers.data(name="lb", shape=[], dtype="int64")
+        # dense reference form
+        onehot = L.one_hot(lb, V)
+        soft = L.label_smooth(onehot, epsilon=eps)
+        dense = L.softmax_with_cross_entropy(lg, soft, soft_label=True)
+        # fused form (the transformer_wmt rewrite)
+        hard = L.softmax_with_cross_entropy(lg, L.unsqueeze(lb, axes=[1]))
+        m = L.reduce_max(lg, dim=[-1], keep_dim=True)
+        se = L.reduce_sum(L.exp(L.elementwise_sub(lg, m)), dim=[-1],
+                          keep_dim=True)
+        lse = L.elementwise_add(m, L.log(se))
+        mean_x = L.scale(L.reduce_sum(lg, dim=[-1], keep_dim=True),
+                         scale=1.0 / V)
+        fused = L.elementwise_add(
+            L.scale(hard, scale=1.0 - eps),
+            L.scale(L.elementwise_sub(lse, mean_x), scale=eps))
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        d, f = exe.run(main, feed={"lg": x, "lb": lab},
+                       fetch_list=[dense, fused])
+    np.testing.assert_allclose(np.asarray(f), np.asarray(d),
+                               rtol=1e-5, atol=1e-6)

@@ -380,3 +380,43 @@ def test_pipeline_dropout_backward_replays_forward_masks():
     assert 0 < mask.mean() < 1
     dW = xv.T @ (mask / dv.size)        # d mean(h*mask) / dW
     np.testing.assert_allclose(w1, w0 - lr * dW, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_nondiff_boundary_var():
+    """A non-differentiable (int) boundary var crossing a cut must not
+    crash the backward: the zero-cotangent fallback reads its shape from
+    the forward-recorded table, which survives the 1F1B stash freeing
+    (r5 review regression). The int mask is built BEFORE the cut producer
+    so it lands in stage 0 and crosses to stage 1 as a boundary var."""
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 5
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[16], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            # stage 0: int mask from x, then the cut var h
+            mask_i = L.cast(L.greater_than(x, L.zeros_like(x)), "int64")
+            h = L.fc(x, size=16, act="relu")
+            # stage 1 consumes BOTH h and the int mask
+            gate = L.reduce_mean(L.cast(mask_i, "float32"), dim=[1],
+                                 keep_dim=True)
+            pred = L.fc(L.elementwise_mul(h, L.cast(mask_i, "float32")),
+                        size=1)
+            loss = L.mean(L.square_error_cost(
+                L.elementwise_mul(pred, gate), y))
+            from paddle_tpu.parallel.pipeline import build_pipeline_plan
+            main._pipeline = build_pipeline_plan(
+                main, loss, [h], pt.optimizer.SGD(0.05), 4, startup,
+                schedule="1f1b")
+    # the int mask really is a stage-0 boundary output
+    assert mask_i.name in main._pipeline.stages[0].out_names
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.standard_normal((16, 16)).astype(np.float32),
+            "y": rng.standard_normal((16, 1)).astype(np.float32)}
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for schedule in ("1f1b", "gpipe"):
+            main._pipeline.schedule = schedule
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            assert np.isfinite(float(np.asarray(lv).reshape(-1)[0]))

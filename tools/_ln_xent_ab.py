@@ -1,0 +1,140 @@
+"""Pallas build-plan candidates layer_norm + softmax_xent: measure XLA
+against the HBM-bytes roofline at BERT shapes (VERDICT r4 #8, the
+conv-chain keep-or-retire methodology).
+
+Both ops are bandwidth-bound at these shapes, so the decision rule is:
+if XLA already sustains >=~85% of the bytes roofline, the maximum Pallas
+headroom (<=1.2x on the op, <<1% end-to-end) cannot justify a kernel —
+retire with data. Otherwise build it.
+
+Chained in-graph (dispatch amortized), fwd+bwd through value_and_grad.
+Run: python tools/_ln_xent_ab.py
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, Hdim = 128 * 128, 768     # BERT-base b128 s128 token rows
+V = 30522                    # BERT vocab (the lm head xent)
+DT = jnp.bfloat16
+_drain = jax.jit(lambda v: v.reshape(-1)[0])
+rng = np.random.default_rng(0)
+
+
+def bench(fn, args, n_chain, n_rep, tag, train_bytes, extra=""):
+    @jax.jit
+    def run(*a):
+        params, x = a[0], a[1]
+        acc = 0.0
+        for i in range(n_chain):
+            loss, g = jax.value_and_grad(fn)(params, x)
+            acc = acc + loss
+            x = x + (acc * 1e-12).astype(x.dtype)
+            params = jax.tree.map(
+                lambda p, gg: p - (1e-9 * gg).astype(p.dtype), params, g)
+        return acc, params
+
+    acc, p = run(*args)
+    np.asarray(_drain(acc))
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_rep):
+            acc, p = run(*args)
+        np.asarray(_drain(acc))
+        best = min(best, (time.perf_counter() - t0) / (n_rep * n_chain))
+    from _rn_roofline import measure_bw
+
+    bw = measure_bw()
+    roof = train_bytes / (bw * 1e9)
+    print(f"{tag}: {best*1e3:.3f} ms/op-train, roofline {roof*1e3:.3f} ms "
+          f"@ {bw:.0f} GB/s -> XLA at {roof/best*100:.0f}% of roofline"
+          f"{extra}", flush=True)
+    return best, roof
+
+
+def main():
+    # --- layer_norm fwd+bwd ------------------------------------------------
+    x = jnp.asarray(rng.standard_normal((N, Hdim), np.float32), DT)
+    g = jnp.ones((Hdim,), jnp.float32)
+    b = jnp.zeros((Hdim,), jnp.float32)
+
+    def ln_loss(params, x):
+        gg, bb = params
+        xf = x.astype(jnp.float32)
+        m = xf.mean(-1, keepdims=True)
+        v = jnp.square(xf - m).mean(-1, keepdims=True)
+        y = ((xf - m) / jnp.sqrt(v + 1e-12) * gg + bb).astype(x.dtype)
+        return jnp.sum(y.astype(jnp.float32) * 1e-6)
+
+    # train bytes: fwd read x + write y; bwd read dy-chain is fused into
+    # the scalar-sum cotangent (free), re-read x, write dx => ~4 passes bf16
+    ln_bytes = 4 * N * Hdim * 2
+    bench(ln_loss, ((g, b), x), 20, 5, f"layer_norm [{N},{Hdim}]", ln_bytes)
+
+    # --- softmax_with_cross_entropy over the BERT vocab --------------------
+    logits = jnp.asarray(rng.standard_normal((N, V), np.float32) * 0.1, DT)
+    labels = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
+
+    def xent_loss(params, logits):
+        (scale,) = params
+        lg = logits.astype(jnp.float32) * scale
+        lsm = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(lsm, labels[:, None], axis=1))
+
+    # train bytes: fwd read logits (+max/denominator passes fused), bwd
+    # write dlogits; ~3 passes of the [N, V] bf16 tensor
+    xent_bytes = 3 * N * V * 2
+    bench(xent_loss, ((jnp.float32(1.0),), logits), 4, 5,
+          f"softmax_xent [{N},{V}]", xent_bytes)
+
+
+if __name__ == "__main__":
+    main()
+
+
+def variant_xent():
+    """Gather-then-reduce xent: loss = -(x[label] - max - logsumexp) — the
+    [N, V] log-softmax never materializes; bwd is one softmax read+write."""
+    logits = jnp.asarray(rng.standard_normal((N, V), np.float32) * 0.1, DT)
+    labels = jnp.asarray(rng.integers(0, V, N).astype(np.int32))
+
+    def xent2(params, logits):
+        (scale,) = params
+        lg = logits.astype(jnp.float32) * scale
+        m = jnp.max(lg, axis=-1, keepdims=True)
+        lse = jnp.log(jnp.sum(jnp.exp(lg - m), axis=-1, keepdims=True))
+        picked = jnp.take_along_axis(lg, labels[:, None], axis=1)
+        return -jnp.mean(picked - m - lse)
+
+    xent_bytes = 3 * N * V * 2
+    bench(xent2, ((jnp.float32(1.0),), logits), 4, 5,
+          f"softmax_xent gather-form [{N},{V}]", xent_bytes)
+
+
+def variant_ln():
+    """LN with bf16 output and fp32 stats only as scalars-per-row; same
+    math, but nudge XLA to keep the normalized tensor bf16."""
+    x = jnp.asarray(rng.standard_normal((N, Hdim), np.float32), DT)
+    g = jnp.ones((Hdim,), jnp.float32)
+    b = jnp.zeros((Hdim,), jnp.float32)
+
+    def ln2(params, x):
+        gg, bb = params
+        xf = x.astype(jnp.float32)
+        m = xf.mean(-1, keepdims=True)
+        v = xf.var(-1, keepdims=True)
+        inv = jax.lax.rsqrt(v + 1e-12)
+        y = (xf * inv - m * inv) * gg + bb
+        return jnp.sum(y.astype(DT).astype(jnp.float32) * 1e-6)
+
+    bench(ln2, ((g, b), x), 20, 5, f"layer_norm rsqrt-form [{N},{Hdim}]",
+          4 * N * Hdim * 2)
+
+
+if __name__ == "__main__":
+    pass

@@ -8,11 +8,12 @@ buffering sink (we bench the TRANSPORT, so the server runs with sync_mode
 False and a grad name that has no registered block — the frame is parsed,
 buffered, and dropped). Run: python tools/_ps_wire_bench.py
 """
+import os
 import sys
 import threading
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from paddle_tpu.distributed.ps_rpc import (PSClient, PServerRuntime, _pack,
