@@ -338,7 +338,10 @@ def bench_deepfm(on_tpu: bool):
 
     ds = pt.DatasetFactory().create_dataset("QueueDataset")
     ds.set_batch_size(batch)
-    ds.set_thread(2)
+    # 4 ingest threads (reference MultiSlotDataFeed runs many): at the
+    # healthy-box 52 ms/file parse cost, 2 threads leave ~200 ms of an
+    # ~1.9 s pass unhidden; 4 halve it
+    ds.set_thread(4)
     ds.set_use_var(use_vars)
     ds.set_filelist(files)
 

@@ -12,6 +12,11 @@ from __future__ import annotations
 
 import numpy as np
 
+try:  # probe once: a failed import inside the per-image hot path would
+    import cv2 as _cv2  # re-run a full finder scan per call
+except ImportError:
+    _cv2 = None
+
 __all__ = [
     "resize_short", "to_chw", "center_crop", "random_crop",
     "left_right_flip", "simple_transform", "load_and_transform",
@@ -56,12 +61,10 @@ def resize_short(im, size):
         h_new = size * h // w
     else:
         w_new = size * w // h
-    try:
-        import cv2  # optional fast path, reference-identical interpolation
-
-        return cv2.resize(im, (w_new, h_new), interpolation=cv2.INTER_CUBIC)
-    except ImportError:
-        return _resize_bilinear(im, h_new, w_new)
+    if _cv2 is not None:  # optional fast path, reference interpolation
+        return _cv2.resize(im, (w_new, h_new),
+                           interpolation=_cv2.INTER_CUBIC)
+    return _resize_bilinear(im, h_new, w_new)
 
 
 def to_chw(im, order=(2, 0, 1)):
@@ -127,13 +130,9 @@ def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
 def load_image(file, is_color=True):
     """Decode an image file to an HWC uint8 ndarray. Needs PIL or cv2
     (reference image.py:167 uses cv2)."""
-    try:
-        import cv2
-
-        flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
-        return cv2.imread(file, flag)
-    except ImportError:
-        pass
+    if _cv2 is not None:
+        flag = _cv2.IMREAD_COLOR if is_color else _cv2.IMREAD_GRAYSCALE
+        return _cv2.imread(file, flag)
     try:
         from PIL import Image
 

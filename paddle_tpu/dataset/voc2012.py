@@ -32,8 +32,13 @@ def is_synthetic() -> bool:
     return locate("voc2012", _TAR) is None
 
 
+_SPLIT_SEED = {"trainval": 11, "train": 12, "val": 13}
+
+
 def _synthetic(sub_name: str):
-    rng = np.random.default_rng(hash(sub_name) % (2 ** 31))
+    # fixed per-split seed: hash() is randomized per process and would
+    # break the dataset package's deterministic-fallback contract
+    rng = np.random.default_rng(_SPLIT_SEED[sub_name])
     h, w = _SYN_HW
     for _ in range(_SYN[sub_name]):
         img = rng.integers(0, 64, (h, w, 3), dtype=np.uint8)

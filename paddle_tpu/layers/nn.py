@@ -2182,8 +2182,9 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
     """reference nn.py im2sequence: sliding-window im2col. Padded design
     returns [B, n_windows, C*kh*kw] (the reference flattens the batch into
     the LoD)."""
-    if input_image_size is not None or (
-            out_stride != 1 and out_stride != [1, 1]):
+    os_ = (list(out_stride) if isinstance(out_stride, (list, tuple))
+           else [out_stride] * 2)
+    if input_image_size is not None or os_ != [1, 1]:
         # the reference uses these for per-image real-size window counts
         # (im2sequence_op.cc batch-LoD path); silently ignoring them would
         # return wrong window counts — refuse like dynamic_lstmp peepholes

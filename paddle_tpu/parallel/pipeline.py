@@ -657,10 +657,6 @@ class PipelinePlan:
         return results
 
 
-def _infer_batch(arr) -> int:
-    return int(np.asarray(arr).shape[0])
-
-
 def _device_of(arr):
     import jax
 

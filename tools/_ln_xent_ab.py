@@ -10,10 +10,11 @@ retire with data. Otherwise build it.
 Chained in-graph (dispatch amortized), fwd+bwd through value_and_grad.
 Run: python tools/_ln_xent_ab.py
 """
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -135,6 +136,3 @@ def variant_ln():
     bench(ln2, ((g, b), x), 20, 5, f"layer_norm rsqrt-form [{N},{Hdim}]",
           4 * N * Hdim * 2)
 
-
-if __name__ == "__main__":
-    pass
