@@ -51,6 +51,7 @@ __all__ = [
     "SpectralNorm",
     "Conv3D",
     "Conv3DTranspose",
+    "TreeConv",
 ]
 
 _state = {"enabled": False, "tape": None, "no_grad": 0, "rng": None}
@@ -787,6 +788,36 @@ class Conv3D(Layer):
                      attrs=dict(self._attrs))["Output"]
         bias = _dy_op("reshape2", {"X": [self.bias]},
                       attrs={"shape": [1, -1, 1, 1, 1]})["Out"]
+        out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py TreeConv (TBCNN over continuous binary
+    trees). forward(nodes_vector [B,N,F], edge_set [B,E,2]) -> [B,N,O,M]
+    via the tree_conv registry op; max_depth bounds the patch walk."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", dtype="float32"):
+        super().__init__()
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [feature_size, 3, output_size, num_filters], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], dtype,
+                                          is_bias=True))
+        self._attrs = {"max_depth": max_depth}
+        self._act = act
+
+    def forward(self, nodes_vector: VarBase, edge_set: VarBase) -> VarBase:
+        out = _dy_op("tree_conv",
+                     {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                      "Filter": [self.weight]},
+                     attrs=dict(self._attrs))["Out"]
+        bias = _dy_op("reshape2", {"X": [self.bias]},
+                      attrs={"shape": [1, 1, 1, -1]})["Out"]
         out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
         if self._act:
             out = _dy_op(self._act, {"X": [out]})["Out"]

@@ -176,3 +176,34 @@ def test_dygraph_nce_trains():
         gw = layer.weight.gradient()
         assert gw is not None and np.isfinite(np.asarray(gw)).all()
         assert np.abs(np.asarray(gw)).sum() > 0
+
+
+def test_dygraph_tree_conv_matches_static():
+    rng = np.random.default_rng(8)
+    B, N, F, O, M = 2, 6, 5, 4, 3
+    nodes = rng.standard_normal((B, N, F)).astype(np.float32)
+    # simple chains: 1-indexed (parent, child); 0 pads
+    edges = np.zeros((B, 5, 2), np.int64)
+    edges[:, 0] = [1, 2]
+    edges[:, 1] = [2, 3]
+    edges[:, 2] = [1, 4]
+    with dg.guard():
+        # act=None isolates the linear part; default act is tanh like the
+        # reference. Set a NONZERO bias so the bias-add path is exercised.
+        layer = dg.TreeConv(feature_size=F, output_size=O, num_filters=M,
+                            act=None)
+        layer.bias._value = layer.bias._value + np.arange(
+            M, dtype=np.float32)
+        got = layer(dg.to_variable(nodes), dg.to_variable(edges)).numpy()
+        w, b = layer.weight.numpy(), layer.bias.numpy()
+        assert np.abs(b).sum() > 0
+
+    def build():
+        nv = L.data(name="nodes", shape=[N, F], dtype="float32")
+        ev = L.data(name="edges", shape=[5, 2], dtype="int64")
+        return L.tree_conv(nv, ev, output_size=O, num_filters=M, act=None,
+                           bias_attr=False)
+
+    ref = _static_eval(build, {"nodes": nodes, "edges": edges}, [w])
+    np.testing.assert_allclose(got - b.reshape(1, 1, 1, -1), ref,
+                               rtol=1e-4, atol=1e-5)
