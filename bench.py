@@ -27,6 +27,21 @@ import numpy as np
 RN50_FWD_FLOPS_PER_IMG = 2 * 4.089e9
 
 
+def _timed_windows(run_once, drain, iters: int, passes: int) -> list:
+    """The ONE timing protocol for every bench row: `passes` windows of
+    `iters` async-dispatched steps each, ended by a host drain read; the
+    per-step seconds of every window are returned so the artifact records
+    interference spread and min(windows) is the steady-state estimate."""
+    windows = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run_once()
+        np.asarray(drain())
+        windows.append((time.perf_counter() - t0) / iters)
+    return windows
+
+
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "cpu").lower()
     table = {
@@ -74,16 +89,12 @@ def _bert_step_time(cfg, batch, seq_len, iters):
         # best-of-2 passes: machine interference through the shared
         # tunnel is one-sided (observed bimodal WMT throughput, PERF r4),
         # so min-time is the honest steady-state estimate
-        dt = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                exe.run(main_p, feed=feed)
-            np.asarray(pt.global_scope().find_var("lm_head.b"))
-            dt = min(dt, (time.perf_counter() - t0) / iters)
+        windows = _timed_windows(
+            lambda: exe.run(main_p, feed=feed),
+            lambda: pt.global_scope().find_var("lm_head.b"), iters, 2)
         (loss,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
         assert np.isfinite(float(np.asarray(loss)))
-    return dt
+    return min(windows), windows
 
 
 # BERT-base hyperparameters shared by the headline bench and its s512
@@ -106,7 +117,7 @@ def bench_bert(on_tpu: bool, peak: float):
         cfg = transformer.bert_tiny(use_tp=False)
         batch, seq_len, iters = 8, 32, 5
 
-    dt = _bert_step_time(cfg, batch, seq_len, iters)
+    dt, windows = _bert_step_time(cfg, batch, seq_len, iters)
     tokens = batch * seq_len
     # matmul-participating parameter count: word/position embedding tables
     # are lookups, not matmuls, so they are EXCLUDED from the 6N term; the
@@ -115,7 +126,7 @@ def bench_bert(on_tpu: bool, peak: float):
     n_params = L_ * (4 * H * H + 2 * H * F) + H * V
     step_flops = 6 * n_params * tokens + 12 * L_ * H * seq_len * tokens
     mfu = (step_flops / dt) / peak
-    return tokens / dt, mfu
+    return tokens / dt, mfu, [round(tokens / w, 1) for w in windows]
 
 
 def bench_bert_long(on_tpu: bool):
@@ -143,7 +154,7 @@ def bench_bert_long(on_tpu: bool):
     for flash in (False, True):
         cfg = transformer.TransformerConfig(use_flash_attention=flash,
                                             **base)
-        dt = _bert_step_time(cfg, batch, seq, iters)
+        dt, _ = _bert_step_time(cfg, batch, seq, iters)
         out["pallas" if flash else "xla"] = batch * seq / dt
     return out
 
@@ -204,16 +215,17 @@ def bench_resnet(on_tpu: bool, peak: float):
         v = pt.global_scope().find_var(drain)
         assert v is not None, drain
         np.asarray(v)
-        dt = float("inf")
-        for _ in range(2):  # best-of-2 (one-sided interference, PERF r4)
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                exe.run(main_p, feed=feed)
-            np.asarray(pt.global_scope().find_var(drain))
-            dt = min(dt, (time.perf_counter() - t0) / iters)
+        # 3 recorded windows: RN50 is the gate row, so its artifact
+        # carries the same interference forensics as WMT/DeepFM
+        windows = _timed_windows(
+            lambda: exe.run(main_p, feed=feed),
+            lambda: pt.global_scope().find_var(drain), iters,
+            3 if on_tpu else 2)
+        dt = min(windows)
         (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
         assert np.isfinite(float(np.asarray(lv)))
     img_s = batch / dt
+    rn_windows = [round(batch / w, 1) for w in windows]
     # FLOP convention fix (r4): the canonical "4.089 GFLOPs" for RN50@224
     # counts a multiply-add as ONE op (it is 4.089 GMACs — exact per-layer
     # enumeration in tools/_rn_stagecost.py gives 8.17 GF/img at 2 ops/MAC).
@@ -221,7 +233,7 @@ def bench_resnet(on_tpu: bool, peak: float):
     # per MAC, so the model FLOPs must too — r2/r3 reported RN50 MFU at
     # half its true value (PERF.md r4).
     mfu = (3 * RN50_FWD_FLOPS_PER_IMG * img_s) / peak  # train ~3x fwd
-    return img_s, mfu
+    return img_s, mfu, rn_windows
 
 
 def bench_wmt(on_tpu: bool, peak: float):
@@ -271,13 +283,10 @@ def bench_wmt(on_tpu: bool, peak: float):
         # is one interference burst from red, and its bimodality is
         # documented — more, shorter windows dodge single bursts and the
         # recorded spread distinguishes outliers from regressions)
-        windows = []
-        for _ in range(3 if on_tpu else 2):
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                exe.run(main_p, feed=feed)
-            np.asarray(pt.global_scope().find_var(drain))
-            windows.append((time.perf_counter() - t0) / iters)
+        windows = _timed_windows(
+            lambda: exe.run(main_p, feed=feed),
+            lambda: pt.global_scope().find_var(drain), iters,
+            3 if on_tpu else 2)
         dt = min(windows)
         (lv,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
         assert np.isfinite(float(np.asarray(lv)))
@@ -387,8 +396,8 @@ def main():
     on_tpu = dev.platform == "tpu"
     peak = _peak_flops(dev)
 
-    tok_s, bert_mfu = bench_bert(on_tpu, peak)
-    img_s, rn_mfu = bench_resnet(on_tpu, peak)
+    tok_s, bert_mfu, bert_windows = bench_bert(on_tpu, peak)
+    img_s, rn_mfu, rn_windows = bench_resnet(on_tpu, peak)
     wmt_tok_s, wmt_mfu, wmt_windows = bench_wmt(on_tpu, peak)
     ctr_ex_s, ctr_windows = bench_deepfm(on_tpu)
     long_ctx = bench_bert_long(on_tpu)
@@ -422,8 +431,10 @@ def main():
         "vs_baseline": round(vs_baseline, 4),
         "vs_target": {k: round(v, 4) for k, v in vs_target.items()},
         "bert_train_tokens_per_sec_per_chip": round(tok_s, 2),
+        "bert_windows_tok_s": bert_windows,
         "bert_mfu": round(bert_mfu, 4),
         "resnet50_images_per_sec_per_chip": round(img_s, 2),
+        "resnet50_windows_img_s": rn_windows,
         "resnet50_mfu": round(rn_mfu, 4),
         "transformer_wmt_tokens_per_sec_per_chip": round(wmt_tok_s, 2),
         "transformer_wmt_windows_tok_s": wmt_windows,

@@ -816,9 +816,10 @@ class TreeConv(Layer):
                      {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
                       "Filter": [self.weight]},
                      attrs=dict(self._attrs))["Out"]
-        bias = _dy_op("reshape2", {"X": [self.bias]},
-                      attrs={"shape": [1, 1, 1, -1]})["Out"]
-        out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
+        # bias targets the TRAILING (filter) dim: axis=-1 broadcast, no
+        # reshape needed (the Conv2D/3D reshape pattern is channel-dim only)
+        out = _dy_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                     attrs={"axis": -1})["Out"]
         if self._act:
             out = _dy_op(self._act, {"X": [out]})["Out"]
         return out
