@@ -59,6 +59,14 @@ class Communicator:
         self._grads_sent = 0
         self._lock = threading.Lock()
         self._send_errors: dict[str, Exception] = {}
+        # merged-batch retry: short and bounded — the PSClient already
+        # retries each wire RPC with backoff, so this layer only papers over
+        # failures that poison a whole merge (e.g. one endpoint of a sliced
+        # send); anything longer would stall every queue behind it
+        from ..resilience.retry import RetryPolicy
+
+        self._send_retry = RetryPolicy(max_attempts=2, base_delay=0.02,
+                                       max_delay=0.1, deadline=5.0)
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -152,7 +160,7 @@ class Communicator:
                     waits += 1
                     time.sleep(0.002)
             try:
-                self._send_merged(name, ctx, batch)
+                self._send_retry.call(self._send_merged, name, ctx, batch)
                 # transient failures don't poison — but only THIS grad's
                 # success clears its entry; another grad's healthy sends
                 # must not mask a broken one

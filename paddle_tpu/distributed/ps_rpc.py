@@ -155,6 +155,26 @@ class PSClient:
         # unsynchronized check-then-create could hand two threads the same
         # Connection under different locks
         self._create_lock = threading.Lock()
+        self._retry = None  # lazy RetryPolicy (resilience/retry.py)
+
+    def _policy(self):
+        if self._retry is None:
+            from ..resilience.retry import rpc_policy
+
+            self._retry = rpc_policy()
+        return self._retry
+
+    def _drop_conn(self, ep: str) -> None:
+        """Forget a (possibly broken) connection so the next RPC redials."""
+        with self._create_lock:
+            lock = self._locks.setdefault(ep, threading.Lock())
+        with lock:
+            conn = self._conns.pop(ep, None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
 
     @classmethod
     def get(cls, endpoints, trainer_id) -> "PSClient":
@@ -198,29 +218,52 @@ class PSClient:
         return rmeta, rtensors
 
     # -- RPCClient contract --------------------------------------------------
+    # Transient transport failures retry under the resilience rpc_policy,
+    # redialing the endpoint between attempts. Dense sends are idempotent
+    # within a round (the server keeps last-per-trainer); a sparse re-send
+    # after an ambiguous mid-reply failure can double rows — the same
+    # at-least-once tradeoff the reference gRPC retry path accepts.
     def send_var(self, ep: str, name: str, value) -> None:
+        from ..resilience.faults import fault_point
+
         if hasattr(value, "rows"):  # SelectedRows
-            self._call(ep, {"op": "send", "name": name,
-                            "trainer": self.trainer_id, "kind": "sparse",
-                            "height": int(value.height)},
-                       [np.asarray(value.rows), np.asarray(value.values)])
+            meta = {"op": "send", "name": name, "trainer": self.trainer_id,
+                    "kind": "sparse", "height": int(value.height)}
+            tensors = [np.asarray(value.rows), np.asarray(value.values)]
         else:
-            self._call(ep, {"op": "send", "name": name,
-                            "trainer": self.trainer_id, "kind": "dense"},
-                       [np.asarray(value)])
+            meta = {"op": "send", "name": name, "trainer": self.trainer_id,
+                    "kind": "dense"}
+            tensors = [np.asarray(value)]
+
+        def _do():
+            fault_point("ps.send")
+            self._call(ep, meta, tensors)
+
+        self._policy().call(_do, on_retry=lambda a, e: self._drop_conn(ep))
 
     def get_var(self, ep: str, name: str) -> np.ndarray:
-        _, (v,) = self._call(ep, {"op": "get", "name": name,
-                                  "trainer": self.trainer_id})
-        return v
+        from ..resilience.faults import fault_point
+
+        def _do():
+            fault_point("ps.recv")
+            _, (v,) = self._call(ep, {"op": "get", "name": name,
+                                      "trainer": self.trainer_id})
+            return v
+
+        return self._policy().call(
+            _do, on_retry=lambda a, e: self._drop_conn(ep))
 
     def prefetch(self, ep: str, name: str, ids) -> np.ndarray:
         """Fetch only the given (slice-local) rows of a server-resident
         table (reference RPCClient::AsyncPrefetchVar rpc_client.h:62 +
         RequestPrefetchHandler) — the whole table never travels."""
-        _, (v,) = self._call(ep, {"op": "prefetch", "name": name},
-                             [np.asarray(ids, np.int64)])
-        return v
+        def _do():
+            _, (v,) = self._call(ep, {"op": "prefetch", "name": name},
+                                 [np.asarray(ids, np.int64)])
+            return v
+
+        return self._policy().call(
+            _do, on_retry=lambda a, e: self._drop_conn(ep))
 
     def send_barrier(self) -> None:
         """Blocks until the server has aggregated + applied this round."""
@@ -607,7 +650,9 @@ class PServerRuntime:
 def send_delta_sections(client, name: str, delta, epmap, sections) -> None:
     """Geo-SGD push: ship an accumulated parameter DELTA under the PARAM
     wire name (server adds it, no optimizer). Shares iter_sections so the
-    slicing math cannot drift from send_sections."""
+    slicing math cannot drift from send_sections. NOT retried at this layer:
+    the server ADDS deltas, so an ambiguous re-send would double-apply —
+    geo's rebase-on-pull makes a lost push self-correcting instead."""
     for ep, wire, part in iter_sections(name, delta, epmap, sections):
         client._call(ep, {"op": "send", "name": wire,
                           "trainer": client.trainer_id, "kind": "delta"},

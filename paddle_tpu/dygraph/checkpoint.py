@@ -71,13 +71,28 @@ def load_dygraph(model_path: str):
     if os.path.exists(opath):
         opt = _load_npz(opath)
     if params is None and opt is None:
-        raise ValueError(
+        raise IOError(
             f"no checkpoint found at '{model_path}' (.pdparams/.pdopt)")
     return params, opt
 
 
 def _load_npz(path: str) -> dict:
+    """Read one checkpoint container, translating every failure mode into an
+    IOError that names the path — a resume script's `except IOError` must
+    catch a truncated file the same way it catches a missing one, not chase
+    whatever zipfile/numpy internals happen to raise."""
+    if not os.path.exists(path):
+        raise IOError(f"checkpoint file '{path}' does not exist")
     if not zipfile.is_zipfile(path):
-        raise ValueError(f"'{path}' is not a dygraph checkpoint")
-    with np.load(path, allow_pickle=False) as z:
-        return {k: z[k] for k in z.files}
+        raise IOError(
+            f"checkpoint file '{path}' is corrupt or not a dygraph "
+            f"checkpoint (not a valid npz container)")
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+    except IOError:
+        raise
+    except Exception as e:
+        raise IOError(
+            f"checkpoint file '{path}' is corrupt: failed to read arrays "
+            f"({type(e).__name__}: {e})") from e

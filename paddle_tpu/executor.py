@@ -34,6 +34,7 @@ import numpy as np
 from . import flags
 from .framework import OpError, Program, Variable, default_main_program
 from .ops.registry import ExecContext, get_op_def
+from .resilience.faults import fault_point
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard"]
 
@@ -504,6 +505,11 @@ class Executor:
         else:
             prog_cache[sig] = prog_cache.pop(sig)  # LRU refresh
 
+        # per-step fault site (resilience/faults.py): fires once per executed
+        # step, before any state is read or donated — an injected "collective
+        # partner lost" fault leaves the scope untouched and retryable
+        fault_point("collective.step")
+
         ro_vals = tuple(self._fetch_state(scope, n) for n in comp.ro_names)
         rw_vals = tuple(self._fetch_state(scope, n) for n in comp.rw_names)
         if comp.global_shardings is not None:
@@ -633,6 +639,22 @@ class Executor:
                 print(f"batch {n_batches} ({n_batches / dt:.1f} batch/s) "
                       f"{msg}", flush=True)
 
+    def invalidate_cache(self, program=None):
+        """Drop compiled executables for `program` (or all programs).
+
+        Recovery hook for the resilience runner (resilience/runner.py): a
+        poisoned cached executable — or donated-buffer bookkeeping left
+        inconsistent by a step that died mid-run — recompiles from the
+        Program IR on the next run instead of failing forever."""
+        if program is None:
+            self._cache = weakref.WeakKeyDictionary()
+        else:
+            from .compiler import CompiledProgram
+
+            if isinstance(program, CompiledProgram):
+                program = program._program
+            self._cache.pop(program, None)
+
     def close(self):
         """Notify pservers this trainer is done (reference executor.cc:95
         SendComplete via exe.close())."""
@@ -656,6 +678,9 @@ class Executor:
     def _compile(
         self, program, block, feed_names, feed_vals, fetch_names, scope, mesh, spmd_mode="gspmd"
     ):
+        # fires only on a cache miss — exactly the boundary where an XLA
+        # compile OOM / coordinator timeout would surface on a pod
+        fault_point("executor.compile")
         ro_names, rw_names, extra_w = _analyze_block(block, feed_names, scope)
 
         if _has_host_ops(block):

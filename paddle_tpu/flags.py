@@ -85,3 +85,24 @@ _define("communicator_min_send_grad_num_before_recv", 20,
         "grads sent before the recv thread starts pulling params")
 _define("communicator_send_wait_times", 5,
         "short waits the send thread spends collecting grads to merge")
+# resilience runtime knobs (resilience/: faults, retry, checkpoint, runner)
+_define("fault_plan", "",
+        "deterministic fault-injection plan for the named runtime sites "
+        "(resilience/faults.py grammar, e.g. 'ckpt.write:2;ps.send:1' or "
+        "'rand:p=0.1,seed=7,max=5'); empty = injection off")
+_define("retry_max_attempts", 4,
+        "RetryPolicy: attempts per call for transient RPC/IO failures")
+_define("retry_base_delay_ms", 50,
+        "RetryPolicy: first backoff delay in milliseconds")
+_define("retry_max_delay_ms", 2000,
+        "RetryPolicy: backoff ceiling in milliseconds")
+_define("retry_deadline_s", 30.0,
+        "RetryPolicy: wall-clock budget for all attempts of one call; "
+        "0 = unbounded")
+_define("ckpt_keep_last_k", 3,
+        "CheckpointManager: versioned step directories kept after GC")
+_define("ckpt_save_every", 10,
+        "CheckpointedRunner: checkpoint cadence in steps")
+_define("runner_max_retries", 5,
+        "CheckpointedRunner: per-step recovery attempts (restore+retry, "
+        "cache invalidation, disable_jit) before the error surfaces")

@@ -84,6 +84,8 @@ class ParameterServerFleet:
 
         from ...executor import Executor, global_scope
 
+        from ...resilience.retry import io_policy
+
         exe = Executor()
         exe.run(self._transpiler.get_startup_program())
         if model_dir:
@@ -94,7 +96,14 @@ class ParameterServerFleet:
                 raise FileNotFoundError(
                     f"init_server: no checkpoint for this endpoint at {path}")
             scope = global_scope()
-            data = np.load(path)
+            # a shared-filesystem read races the writer's rename on real
+            # clusters — retry transient I/O before giving up
+            try:
+                data = io_policy().call(np.load, path)
+            except Exception as e:
+                raise IOError(
+                    f"init_server: checkpoint at {path} is unreadable "
+                    f"({type(e).__name__}: {e})") from e
             for n in data.files:
                 scope.set_var(n, data[n])
 
@@ -117,12 +126,15 @@ class ParameterServerFleet:
         save_persistables + checkpoint_notify — slices never travel)."""
         from ... import io
         from ...distributed.ps_rpc import PSClient
+        from ...resilience.retry import rpc_policy
 
         io.save_persistables(executor, dirname,
                              main_program or self._origin_main)
         client = PSClient.get(tuple(self.server_endpoints),
                               self.worker_index())
-        client.checkpoint_notify(dirname)
+        # the notify itself is idempotent (each pserver rewrites its own
+        # slice file atomically), so a retried RPC is safe
+        rpc_policy().call(client.checkpoint_notify, dirname)
 
     # -- worker lifecycle ----------------------------------------------------
     def init_worker(self):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Callable, Sequence
 
 import numpy as np
@@ -242,11 +243,19 @@ def save_sharded(executor=None, dirname="", main_program=None, scope=None):
     exactly what SURVEY §5 says does not scale to pods). Arrays keep their
     NamedShardings, so ZeRO-sharded optimizer states and TP-sharded params
     round-trip without ever materializing on one host.
+
+    Atomic: the checkpoint is written into a sibling temp directory and
+    renamed over `dirname` only after the orbax commit finishes — an
+    interrupted or failed save leaves at worst a `.tmp-*` orphan, never a
+    half-written tree under the target name. Transient I/O failures retry
+    under the resilience io_policy (`ckpt.write` fault site).
     """
     import orbax.checkpoint as ocp
 
     from .executor import global_scope
     from .framework import default_main_program
+    from .resilience.faults import fault_point
+    from .resilience.retry import io_policy
 
     program = main_program or default_main_program()
     scope = scope or global_scope()
@@ -257,11 +266,36 @@ def save_sharded(executor=None, dirname="", main_program=None, scope=None):
         val = scope.find_var(v.name)
         if val is not None:
             tree[_encode_name(v.name)] = val
+    import jax
+
     path = os.path.abspath(dirname)
+    # the stage name must be IDENTICAL across processes (orbax coordinates
+    # the multi-host write against one directory), so no pid in it; only
+    # process 0 performs the commit rename after the write barrier
+    tmp = f"{path}.tmp-stage"
+    primary = jax.process_index() == 0
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, tree, force=True)
-    ckptr.wait_until_finished()
-    ckptr.close()
+    try:
+        def _write():
+            fault_point("ckpt.write")
+            ckptr.save(tmp, tree, force=True)
+            ckptr.wait_until_finished()
+
+        io_policy().call(_write)
+        if primary:
+            # swap into place; keep the previous checkpoint aside until the
+            # new one is committed so a crash mid-swap still leaves a
+            # loadable copy
+            old = f"{path}.old"
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.exists(path):
+                os.replace(path, old)
+            os.replace(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+    finally:
+        ckptr.close()
+        if primary:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def load_sharded(executor=None, dirname="", main_program=None, scope=None,
@@ -288,34 +322,43 @@ def load_sharded(executor=None, dirname="", main_program=None, scope=None,
     # orbax's restore raises on tree mismatches
     path = os.path.abspath(dirname)
     ckptr = ocp.StandardCheckpointer()
-    # restore targets must match the on-disk tree exactly, so read the saved
-    # key set from the checkpoint metadata; a layout whose metadata can't be
-    # read falls back to the full program tree (which still restores when
-    # the trees happen to match)
     try:
-        saved_keys = set(ckptr.metadata(path).item_metadata.keys())
-        names = [n for n in names if _encode_name(n) in saved_keys]
-    except (AttributeError, ValueError, KeyError, FileNotFoundError):
-        pass
-    # abstract restore targets: shape/dtype from the program, placement from
-    # `shardings` / current scope values
-    target = {}
-    for n in names:
-        enc = _encode_name(n)
-        cur = scope.find_var(n)
-        if shardings and n in shardings:
-            var = program.global_block.var(n)
-            target[enc] = jax.ShapeDtypeStruct(
-                tuple(var.shape), var.np_dtype, sharding=shardings[n])
-        elif cur is not None and hasattr(cur, "sharding"):
-            target[enc] = jax.ShapeDtypeStruct(
-                tuple(cur.shape), cur.dtype, sharding=cur.sharding)
-        else:
-            var = program.global_block.var(n)
-            target[enc] = jax.ShapeDtypeStruct(tuple(var.shape),
-                                               var.np_dtype)
-    restored = ckptr.restore(path, target)
-    ckptr.close()
+        # restore targets must match the on-disk tree exactly, so read the
+        # saved key set from the checkpoint metadata (a dict of per-array
+        # metadata on current orbax, an object with .item_metadata on older
+        # releases); a layout whose metadata can't be read falls back to the
+        # full program tree (which still restores when the trees happen to
+        # match)
+        try:
+            md = ckptr.metadata(path)
+            items = getattr(md, "item_metadata", md)
+            saved_keys = set(items.keys())
+            names = [n for n in names if _encode_name(n) in saved_keys]
+        except (AttributeError, TypeError, ValueError, KeyError,
+                FileNotFoundError):
+            pass
+        # abstract restore targets: shape/dtype from the program, placement
+        # from `shardings` / current scope values
+        target = {}
+        for n in names:
+            enc = _encode_name(n)
+            cur = scope.find_var(n)
+            if shardings and n in shardings:
+                var = program.global_block.var(n)
+                target[enc] = jax.ShapeDtypeStruct(
+                    tuple(var.shape), var.np_dtype, sharding=shardings[n])
+            elif cur is not None and hasattr(cur, "sharding"):
+                target[enc] = jax.ShapeDtypeStruct(
+                    tuple(cur.shape), cur.dtype, sharding=cur.sharding)
+            else:
+                var = program.global_block.var(n)
+                target[enc] = jax.ShapeDtypeStruct(tuple(var.shape),
+                                                   var.np_dtype)
+        from .resilience.retry import io_policy
+
+        restored = io_policy().call(ckptr.restore, path, target)
+    finally:
+        ckptr.close()
     for n in names:
         enc = _encode_name(n)
         if enc in restored:

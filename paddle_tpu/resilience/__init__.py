@@ -1,0 +1,40 @@
+"""Fault-tolerant training runtime (SURVEY §5 checkpoint/resume, grown up).
+
+On a pod, preemption and transient I/O or RPC failure are the normal case:
+the reference stack leaned on pserver-side checkpointing and retry loops for
+exactly this. This package is the TPU-native equivalent — four pieces that
+compose into a training loop that survives partial failure:
+
+  * faults    — seeded, deterministic fault-injection registry; named sites
+                raise on a reproducible schedule so every recovery path is
+                testable on one host (`FLAGS_fault_plan` / `fault_scope`).
+  * retry     — `RetryPolicy` (exponential backoff + deterministic jitter +
+                deadline) applied to pserver RPCs and orbax checkpoint I/O.
+  * checkpoint— `CheckpointManager`: atomic per-step versioned directories
+                over save_sharded/load_sharded with a manifest (step,
+                program hash, RNG counter), keep-last-k GC, corrupt-
+                checkpoint rollback, and `latest_step()` auto-resume.
+  * runner    — `CheckpointedRunner`: an Executor.run training loop with
+                periodic save, restore-and-replay on fault, and graceful
+                degradation (cache invalidation, then jax.disable_jit)
+                before surfacing the error.
+"""
+from .faults import (  # noqa: F401
+    FAULT_SITES,
+    FaultPlan,
+    InjectedFault,
+    fault_point,
+    fault_scope,
+    fault_stats,
+    install_plan,
+)
+from .retry import RetryPolicy, io_policy, rpc_policy  # noqa: F401
+from .checkpoint import CheckpointManager  # noqa: F401
+from .runner import CheckpointedRunner, StepFailure  # noqa: F401
+
+__all__ = [
+    "FAULT_SITES", "FaultPlan", "InjectedFault", "fault_point",
+    "fault_scope", "fault_stats", "install_plan",
+    "RetryPolicy", "io_policy", "rpc_policy",
+    "CheckpointManager", "CheckpointedRunner", "StepFailure",
+]

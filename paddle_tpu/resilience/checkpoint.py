@@ -1,0 +1,245 @@
+"""CheckpointManager: atomic, versioned, self-describing train checkpoints.
+
+Layered on io.save_sharded/load_sharded (the host-parallel orbax path). A
+checkpoint root looks like:
+
+    root/
+      step_00000010/
+        manifest.json     step, program hash, RNG run-counter, var names
+        state/            orbax/TensorStore sharded arrays
+      step_00000020/
+        ...
+
+Guarantees the bare save_sharded cannot give:
+
+  * atomic visibility — a step directory appears under its final name only
+    after every byte (state + manifest) is on disk and fsync'd; a crash
+    mid-save leaves a `.tmp-*` orphan that the next GC sweeps, never a
+    half-checkpoint that a resume could trust;
+  * versioning + GC — per-step directories, keep-last-k pruning;
+  * provenance — the manifest records the program hash (a resume against a
+    different program warns/fails instead of silently loading mismatched
+    state) and the scope's RNG run-counter (so counter-derived randomness
+    continues, not restarts, after resume);
+  * rollback — restore() walks steps newest-first, quarantines unreadable or
+    corrupt candidates to `.corrupt-*`, and falls back to the newest good
+    one (the reference trainer's "load last good checkpoint" loop).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+import warnings
+
+__all__ = ["CheckpointManager"]
+
+_MANIFEST = "manifest.json"
+_STATE = "state"
+_STEP_PREFIX = "step_"
+_FORMAT = 1
+
+
+def _program_hash(program) -> str:
+    blob = json.dumps(program.to_dict(), sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # e.g. platforms without O_RDONLY dirs
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class CheckpointManager:
+    def __init__(self, root: str, keep_last_k: int | None = None,
+                 main_program=None, scope=None):
+        from .. import flags
+
+        self.root = os.path.abspath(root)
+        self.keep_last_k = (flags.get_flag("ckpt_keep_last_k")
+                            if keep_last_k is None else int(keep_last_k))
+        self._program = main_program
+        self._scope = scope
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- context defaults ----------------------------------------------------
+    def _resolve(self, main_program, scope):
+        from ..executor import global_scope
+        from ..framework import default_main_program
+
+        return (main_program or self._program or default_main_program(),
+                scope or self._scope or global_scope())
+
+    # -- directory naming ----------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"{_STEP_PREFIX}{step:08d}")
+
+    def steps(self) -> list[int]:
+        """Steps with a committed (renamed) directory, ascending. Commit
+        atomicity means presence under the final name implies a complete
+        write; manifest validity is still re-checked at restore time."""
+        out = []
+        try:
+            entries = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for name in entries:
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            try:
+                out.append(int(name[len(_STEP_PREFIX):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def read_manifest(self, step: int) -> dict:
+        with open(os.path.join(self._step_dir(step), _MANIFEST)) as f:
+            return json.load(f)
+
+    # -- save ----------------------------------------------------------------
+    def save(self, step: int, executor=None, main_program=None,
+             scope=None) -> str:
+        """Write the checkpoint for `step`; returns the committed path.
+
+        On a multi-process mesh every process calls this (save_sharded needs
+        all of them for its shard writes); the manifest + commit rename are
+        process-0-only, mirroring save_sharded's own commit."""
+        import jax
+
+        from .. import io
+
+        program, scope = self._resolve(main_program, scope)
+        primary = jax.process_index() == 0
+        step = int(step)
+        final = self._step_dir(step)
+        # same stage path on every process — save_sharded coordinates the
+        # multi-host orbax write against it
+        tmp = os.path.join(self.root, f".tmp-{step:08d}")
+        if primary:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+        try:
+            io.save_sharded(executor, os.path.join(tmp, _STATE),
+                            main_program=program, scope=scope)
+            if not primary:
+                return final
+            manifest = {
+                "format": _FORMAT,
+                "step": step,
+                "program_hash": _program_hash(program),
+                "rng_counter": scope._run_counter,
+                "random_seed": program.random_seed or 0,
+                "var_names": sorted(
+                    v.name for v in program.list_vars()
+                    if getattr(v, "persistable", False)
+                    and scope.has_var(v.name)),
+                "time": time.time(),
+            }
+            mpath = os.path.join(tmp, _MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            # commit: the final name appears in one rename; re-saving the
+            # same step replaces the old directory
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+            _fsync_dir(self.root)
+        except BaseException:
+            if primary:
+                shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._gc()
+        return final
+
+    def _gc(self) -> None:
+        """Prune beyond keep-last-k and sweep crash orphans (runs after a
+        successful commit, so any remaining .tmp-* is a dead save)."""
+        if self.keep_last_k and self.keep_last_k > 0:
+            for step in self.steps()[:-self.keep_last_k]:
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        for name in os.listdir(self.root):
+            if name.startswith(".tmp-") or name.startswith(".corrupt-"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    # -- restore -------------------------------------------------------------
+    def _validate(self, step: int, program) -> dict:
+        manifest = self.read_manifest(step)
+        if manifest.get("format") != _FORMAT:
+            raise ValueError(
+                f"checkpoint step {step}: unknown manifest format "
+                f"{manifest.get('format')!r}")
+        if not os.path.isdir(os.path.join(self._step_dir(step), _STATE)):
+            raise FileNotFoundError(
+                f"checkpoint step {step}: missing state directory")
+        want = _program_hash(program)
+        got = manifest.get("program_hash")
+        if got != want:
+            warnings.warn(
+                f"checkpoint step {step} was saved from a different program "
+                f"(hash {got} != {want}); restoring the intersection of "
+                f"persistables", stacklevel=3)
+        return manifest
+
+    def _quarantine(self, step: int, reason: Exception) -> None:
+        src = self._step_dir(step)
+        dst = os.path.join(self.root, f".corrupt-{_STEP_PREFIX}{step:08d}")
+        warnings.warn(
+            f"checkpoint step {step} is unreadable ({reason}); quarantined "
+            f"to {dst} — rolling back to the previous checkpoint",
+            stacklevel=3)
+        shutil.rmtree(dst, ignore_errors=True)
+        try:
+            os.replace(src, dst)
+        except OSError:
+            shutil.rmtree(src, ignore_errors=True)
+
+    def restore(self, step: int | None = None, executor=None,
+                main_program=None, scope=None, shardings=None) -> int | None:
+        """Load the newest good checkpoint (or exactly `step` if given).
+
+        Returns the restored step, or None when the root holds no
+        checkpoint at all (fresh start). Corrupt candidates are quarantined
+        and the next-older one is tried — unless an explicit `step` was
+        requested, which fails hard rather than silently substituting."""
+        from .. import io
+
+        program, scope = self._resolve(main_program, scope)
+        explicit = step is not None
+        candidates = [int(step)] if explicit else list(reversed(self.steps()))
+        if explicit and int(step) not in self.steps():
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} under {self.root}")
+        for cand in candidates:
+            try:
+                manifest = self._validate(cand, program)
+                io.load_sharded(executor,
+                                os.path.join(self._step_dir(cand), _STATE),
+                                main_program=program, scope=scope,
+                                shardings=shardings)
+            except Exception as e:
+                if explicit:
+                    raise
+                self._quarantine(cand, e)
+                continue
+            # resume counter-derived RNG where the save left off, not at 0
+            scope._run_counter = int(manifest.get("rng_counter", 0))
+            return cand
+        return None

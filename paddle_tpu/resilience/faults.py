@@ -1,0 +1,211 @@
+"""Deterministic fault injection for the training runtime.
+
+The runtime's recovery paths (checkpoint rollback, RPC retry, compile-cache
+invalidation) are only trustworthy if they can be exercised on demand, on one
+host, reproducibly. This module plants named *sites* in the hot paths —
+
+    ckpt.write        save_sharded, before the orbax commit
+    ps.send           PSClient.send_var, before the wire
+    ps.recv           PSClient.get_var, before the wire
+    collective.step   Executor.run, once per executed step
+    executor.compile  Executor._compile, before lowering
+
+— and a *plan* that decides, per site and per hit, whether to raise an
+`InjectedFault`. Plans are either explicit hit schedules or seeded Bernoulli
+draws; both are pure functions of (site, hit index), so a failing chaos run
+replays exactly from its plan string.
+
+Plan spec grammar (the `FLAGS_fault_plan` value / `fault_scope` argument):
+
+    "ckpt.write:2;ps.send:1,4"      raise on those 1-based hits of each site
+    "rand:p=0.2,seed=7"             each hit at every site fails w.p. 0.2
+    "rand:p=0.2,seed=7,sites=ps.send|ps.recv,max=5"
+                                    restrict sites; stop after 5 faults total
+
+The schedule is *per-process*: subprocess trainers inherit the plan through
+the FLAGS_fault_plan environment variable (flags.py reads FLAGS_* at import).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+
+__all__ = ["FAULT_SITES", "InjectedFault", "FaultPlan", "fault_point",
+           "fault_scope", "fault_stats", "install_plan"]
+
+# the named sites the runtime instruments; fault_point accepts only these so
+# a typo'd site name fails loudly instead of silently never firing
+FAULT_SITES = frozenset({
+    "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
+})
+
+
+class InjectedFault(ConnectionError):
+    """Raised by fault_point on schedule.
+
+    Subclasses ConnectionError so the injected failure travels the same
+    except-clauses real transport faults do — the recovery code under test
+    must not need to know it is being tested.
+    """
+
+    def __init__(self, site: str, hit: int):
+        super().__init__(f"injected fault at '{site}' (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+class FaultPlan:
+    """A deterministic (site, hit index) -> should-raise schedule."""
+
+    def __init__(self, schedule: dict[str, frozenset[int]] | None = None,
+                 p: float = 0.0, seed: int = 0,
+                 sites: frozenset[str] | None = None,
+                 max_faults: int | None = None, spec: str = ""):
+        self.schedule = schedule or {}
+        self.p = float(p)
+        self.seed = int(seed)
+        self.sites = sites  # None = every site (random mode only)
+        self.max_faults = max_faults
+        self.spec = spec
+        self._hits: dict[str, int] = {}
+        self._fired: list[tuple[str, int]] = []
+        self._lock = threading.Lock()
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        spec = (spec or "").strip()
+        if not spec:
+            return cls(spec=spec)
+        if spec.startswith("rand:"):
+            p, seed, sites, max_faults = 0.0, 0, None, None
+            for kv in spec[len("rand:"):].split(","):
+                k, _, v = kv.partition("=")
+                k, v = k.strip(), v.strip()
+                if k == "p":
+                    p = float(v)
+                elif k == "seed":
+                    seed = int(v)
+                elif k == "sites":
+                    sites = frozenset(s.strip() for s in v.split("|") if s)
+                elif k == "max":
+                    max_faults = int(v)
+                else:
+                    raise ValueError(f"unknown fault-plan key '{k}' in {spec!r}")
+            unknown = (sites or frozenset()) - FAULT_SITES
+            if unknown:
+                raise ValueError(f"unknown fault sites {sorted(unknown)}; "
+                                 f"known: {sorted(FAULT_SITES)}")
+            return cls(p=p, seed=seed, sites=sites, max_faults=max_faults,
+                       spec=spec)
+        schedule: dict[str, frozenset[int]] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            site, _, hits = part.partition(":")
+            site = site.strip()
+            if site not in FAULT_SITES:
+                raise ValueError(f"unknown fault site '{site}'; known: "
+                                 f"{sorted(FAULT_SITES)}")
+            schedule[site] = frozenset(int(h) for h in hits.split(",") if h)
+        return cls(schedule=schedule, spec=spec)
+
+    # -- the decision --------------------------------------------------------
+    def _draw(self, site: str, hit: int) -> bool:
+        """Seeded Bernoulli, pure in (seed, site, hit): a replayed plan makes
+        identical decisions regardless of thread interleaving."""
+        h = hashlib.sha256(f"{self.seed}:{site}:{hit}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2**64 < self.p
+
+    def check(self, site: str) -> None:
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            fire = False
+            if site in self.schedule:
+                fire = hit in self.schedule[site]
+            elif self.p > 0.0 and (self.sites is None or site in self.sites):
+                if (self.max_faults is None
+                        or len(self._fired) < self.max_faults):
+                    fire = self._draw(site, hit)
+            if fire:
+                self._fired.append((site, hit))
+        if fire:
+            raise InjectedFault(site, hit)
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": dict(self._hits), "fired": list(self._fired),
+                    "spec": self.spec}
+
+
+_active: FaultPlan | None = None
+_install_lock = threading.Lock()
+
+
+def _bootstrap_from_flags() -> None:
+    """Pick up FLAGS_fault_plan (env or set_flags) lazily, once."""
+    global _active
+    from .. import flags
+
+    try:
+        spec = flags.get_flag("fault_plan")
+    except KeyError:  # flags module not fully imported yet
+        return
+    if spec:
+        with _install_lock:
+            if _active is None:
+                _active = FaultPlan.parse(spec)
+
+
+_bootstrapped = False
+
+
+def install_plan(plan: "FaultPlan | str | None") -> FaultPlan | None:
+    """Install (or clear, with None) the process-wide plan; returns the
+    previous one. Prefer `fault_scope` in tests."""
+    global _active, _bootstrapped
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    with _install_lock:
+        prev, _active = _active, plan
+        _bootstrapped = True  # explicit install wins over the env flag
+    return prev
+
+
+def fault_point(site: str) -> None:
+    """The instrumented sites call this; near-free when no plan is active."""
+    global _bootstrapped
+    if _active is None:
+        if not _bootstrapped:
+            _bootstrapped = True
+            _bootstrap_from_flags()
+            if _active is None:
+                return
+        else:
+            return
+    if site not in FAULT_SITES:
+        raise ValueError(f"unknown fault site '{site}'; known: "
+                         f"{sorted(FAULT_SITES)}")
+    _active.check(site)
+
+
+@contextmanager
+def fault_scope(plan: "FaultPlan | str"):
+    """Scoped plan for tests: install on entry, restore the previous plan on
+    exit. Yields the plan so the test can assert on .stats()."""
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    prev = install_plan(plan)
+    try:
+        yield plan
+    finally:
+        install_plan(prev)
+
+
+def fault_stats() -> dict:
+    """Hit/fire counters of the active plan ({} when none)."""
+    return _active.stats() if _active is not None else {}

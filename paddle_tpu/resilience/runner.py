@@ -1,0 +1,146 @@
+"""CheckpointedRunner: a fault-tolerant Executor.run training loop.
+
+Wraps the plain `for step: exe.run(...)` loop with the full recovery ladder:
+
+  1. periodic atomic checkpoints (CheckpointManager) every `save_every`
+     steps, plus one at the end of the run;
+  2. auto-resume — `resume()` restores the newest good checkpoint and the
+     loop continues from the following step (the kill-and-resume contract:
+     a SIGKILL'd trainer restarts within one checkpoint of the crash);
+  3. on a step failure: restore the last good checkpoint and *replay*
+     deterministically from it (feeds and RNG are keyed by step index, so
+     the replayed trajectory is bit-identical to an undisturbed run);
+  4. graceful degradation before surfacing: the second failure of the same
+     step also invalidates the executor's compile cache (a poisoned cached
+     executable recompiles), the third runs that one step under
+     `jax.disable_jit()` (an XLA-compile-path failure still makes forward
+     progress); further failures re-raise.
+
+Determinism contract: `feed_fn(step)` must be a pure function of the step
+index, and the runner passes `rng_counter=step + 1` to Executor.run so
+counter-derived randomness (dropout keys) depends only on the step — never
+on how many crashes and replays it took to get there.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from .checkpoint import CheckpointManager
+
+__all__ = ["CheckpointedRunner"]
+
+
+class StepFailure(RuntimeError):
+    """A step kept failing after the whole recovery ladder."""
+
+    def __init__(self, step: int, attempts: int, last: Exception):
+        super().__init__(
+            f"training step {step} failed after {attempts} attempts "
+            f"(restore+retry, cache invalidation, disable_jit all "
+            f"exhausted): {last}")
+        self.step = step
+        self.attempts = attempts
+
+
+class CheckpointedRunner:
+    def __init__(self, executor, manager: "CheckpointManager | str",
+                 main_program=None, scope=None, save_every: int | None = None,
+                 max_retries: int | None = None):
+        """manager: a CheckpointManager or a checkpoint root directory.
+        save_every/max_retries default from FLAGS_ckpt_save_every /
+        FLAGS_runner_max_retries."""
+        from .. import flags
+        from ..executor import global_scope
+        from ..framework import default_main_program
+
+        self.exe = executor
+        self.manager = (manager if isinstance(manager, CheckpointManager)
+                        else CheckpointManager(manager))
+        self.program = main_program or default_main_program()
+        self.scope = scope or global_scope()
+        self.save_every = (flags.get_flag("ckpt_save_every")
+                           if save_every is None else int(save_every))
+        self.max_retries = (flags.get_flag("runner_max_retries")
+                            if max_retries is None else int(max_retries))
+        self.retries_used = 0  # across the whole run, for observability
+
+    # -- resume --------------------------------------------------------------
+    def resume(self, executor=None) -> int:
+        """Restore the newest good checkpoint into the scope; returns the
+        next step index to run (0 on a fresh root)."""
+        restored = self.manager.restore(executor=executor or self.exe,
+                                        main_program=self.program,
+                                        scope=self.scope)
+        return 0 if restored is None else restored + 1
+
+    # -- the guarded step ----------------------------------------------------
+    def _run_step(self, step: int, feed: dict, fetch_list):
+        return self.exe.run(self.program, feed=feed, fetch_list=fetch_list,
+                            scope=self.scope, rng_counter=step + 1)
+
+    def _recover(self, attempt: int, step: int, exc: Exception) -> int:
+        """Roll state back to the last good checkpoint; returns the step the
+        loop must resume from (replay). Escalates with the attempt count."""
+        if attempt >= 2:
+            # a cached executable (or its donated-buffer bookkeeping) may be
+            # the thing that is broken — recompile from scratch
+            invalidate = getattr(self.exe, "invalidate_cache", None)
+            if invalidate is not None:
+                invalidate(self.program)
+        restored = self.manager.restore(executor=self.exe,
+                                        main_program=self.program,
+                                        scope=self.scope)
+        if restored is None:
+            return step  # nothing to roll back to: plain retry
+        return restored + 1
+
+    def run(self, feed_fn: Callable[[int], dict], num_steps: int,
+            fetch_list: Sequence | None = None,
+            on_step: Callable[[int, list], None] | None = None,
+            start_step: int | None = None) -> dict:
+        """Train steps [start, num_steps) with recovery and checkpoints.
+
+        feed_fn(step) -> feed dict, pure in step; on_step(step, outs) fires
+        after every *successful* step (replays re-fire it — consumers keyed
+        by step stay consistent). Returns {"start_step", "results": {step:
+        outs}, "retries"}.
+        """
+        import jax
+
+        start = self.resume() if start_step is None else int(start_step)
+        results: dict[int, list] = {}
+        step = start
+        # per-step failure counts must survive replays: a rollback re-runs
+        # earlier (healthy) steps, and the failing step has to resume its
+        # escalation ladder where it left off, not restart it
+        fails: dict[int, int] = {}
+        while step < num_steps:
+            use_eager = fails.get(step, 0) >= 3  # last rung: step without XLA
+            try:
+                if use_eager:
+                    with jax.disable_jit():
+                        outs = self._run_step(step, feed_fn(step), fetch_list)
+                else:
+                    outs = self._run_step(step, feed_fn(step), fetch_list)
+            except Exception as e:  # noqa: BLE001 — ladder decides
+                nfails = fails.get(step, 0) + 1
+                fails[step] = nfails
+                self.retries_used += 1
+                if nfails > self.max_retries:
+                    raise StepFailure(step, nfails, e) from e
+                step = self._recover(nfails, step, e)
+                continue
+            results[step] = outs
+            if on_step is not None:
+                on_step(step, outs)
+            if self.save_every and (step + 1) % self.save_every == 0:
+                self.manager.save(step, executor=self.exe,
+                                  main_program=self.program, scope=self.scope)
+            step += 1
+        if num_steps > start and (
+                not self.save_every or num_steps % self.save_every != 0):
+            # final state is always durable, whatever the cadence
+            self.manager.save(num_steps - 1, executor=self.exe,
+                              main_program=self.program, scope=self.scope)
+        return {"start_step": start, "results": results,
+                "retries": self.retries_used}

@@ -21,6 +21,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection robustness tests (tools/chaos.py smoke "
+        "plan; fast enough to stay in tier-1)")
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs + scope + name generator."""

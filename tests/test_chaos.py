@@ -1,0 +1,34 @@
+"""Chaos marker: the tools/chaos.py harness, sized for tier-1.
+
+A seeded random fault plan fires at the executor + checkpoint sites while a
+CheckpointedRunner trains; the run must complete and the loss trajectory
+must be bit-identical to the fault-free baseline. Seeded = deterministic: a
+failure here replays exactly with the printed plan string."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import chaos  # noqa: E402
+
+
+@pytest.mark.chaos
+def test_chaos_random_plan_survives_and_matches_baseline(tmp_path):
+    out = chaos.run_chaos(
+        "rand:p=0.2,seed=4,max=5,"
+        "sites=collective.step|executor.compile|ckpt.write",
+        steps=6, seed=4, root=str(tmp_path), verbose=False)
+    assert out["fired"], "plan injected nothing — raise p or steps"
+    assert out["retries"] >= 1
+
+
+@pytest.mark.chaos
+def test_chaos_explicit_plan_every_local_site(tmp_path):
+    out = chaos.run_chaos(
+        "collective.step:3,4;executor.compile:1;ckpt.write:1",
+        steps=6, seed=0, root=str(tmp_path), verbose=False)
+    assert {s for s, _ in out["fired"]} == {
+        "collective.step", "executor.compile", "ckpt.write"}

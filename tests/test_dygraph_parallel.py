@@ -102,5 +102,14 @@ def test_save_load_dygraph_optimizer_state(tmp_path):
 
 
 def test_load_dygraph_missing_raises(tmp_path):
-    with pytest.raises(ValueError, match="no checkpoint"):
+    with pytest.raises(IOError, match="no checkpoint"):
         dg.load_dygraph(str(tmp_path / "nope"))
+
+
+def test_load_dygraph_corrupt_names_path(tmp_path):
+    """A truncated/garbage container raises IOError naming the file, not a
+    bare zipfile/numpy internal error."""
+    path = tmp_path / "model.pdparams"
+    path.write_bytes(b"PK\x03\x04 this is not a real zip")
+    with pytest.raises(IOError, match=str(path)):
+        dg.load_dygraph(str(tmp_path / "model"))

@@ -164,3 +164,91 @@ def test_sharded_checkpoint_roundtrip_on_mesh(tmp_path):
     got = scope.find_var("s.w_0")
     assert got.sharding.spec == P(None, "dp")
     np.testing.assert_allclose(np.asarray(got), before["s.w_0"], rtol=1e-6)
+
+
+def test_load_sharded_restores_program_grown_since_save(tmp_path):
+    """A program that grew new persistables (EMA shadows, slow weights)
+    after the save must still restore: the saved key set from the orbax
+    metadata prunes the restore targets, and the new var keeps its current
+    value instead of aborting the whole load."""
+    exe = pt.Executor()
+    _build_and_train(exe)
+    scope = pt.global_scope()
+    pt.io.save_sharded(exe, str(tmp_path / "ckpt"))
+
+    saved = {n: np.asarray(scope.find_var(n)).copy()
+             for n in scope.var_names()}
+    blk = pt.default_main_program().global_block
+    blk.create_var(name="ema_shadow_0", shape=[4], dtype="float32",
+                   persistable=True)
+    shadow = np.full((4,), 7.0, np.float32)
+    scope.set_var("ema_shadow_0", shadow)
+    for n in saved:
+        scope.set_var(n, np.zeros_like(saved[n]))
+
+    pt.io.load_sharded(exe, str(tmp_path / "ckpt"))
+    for n, v in saved.items():
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), v,
+                                   rtol=1e-6, err_msg=n)
+    np.testing.assert_array_equal(np.asarray(scope.find_var("ema_shadow_0")),
+                                  shadow)
+
+
+def test_load_sharded_metadata_unreadable_falls_back(tmp_path, monkeypatch):
+    """A checkpoint whose metadata can't be read (corrupt/ancient layout)
+    falls back to the full program tree — which still restores when the
+    trees match."""
+    import orbax.checkpoint as ocp
+
+    exe = pt.Executor()
+    _build_and_train(exe)
+    scope = pt.global_scope()
+    pt.io.save_sharded(exe, str(tmp_path / "ckpt"))
+    saved = {n: np.asarray(scope.find_var(n)).copy()
+             for n in scope.var_names()}
+    for n in saved:
+        scope.set_var(n, np.zeros_like(saved[n]))
+
+    def broken_metadata(self, path):
+        raise ValueError("metadata store corrupted")
+
+    monkeypatch.setattr(ocp.StandardCheckpointer, "metadata",
+                        broken_metadata)
+    pt.io.load_sharded(exe, str(tmp_path / "ckpt"))
+    for n, v in saved.items():
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), v,
+                                   rtol=1e-6, err_msg=n)
+
+
+def test_save_sharded_interrupted_write_leaves_target_loadable(tmp_path):
+    """Atomic save: a save that dies on every write attempt must leave the
+    previous checkpoint at the target path untouched and loadable."""
+    from paddle_tpu.resilience import fault_scope
+
+    exe = pt.Executor()
+    _build_and_train(exe)
+    scope = pt.global_scope()
+    path = str(tmp_path / "ckpt")
+    pt.io.save_sharded(exe, path)
+    saved = {n: np.asarray(scope.find_var(n)).copy()
+             for n in scope.var_names()}
+
+    # poison the scope, then fail the save on every retry attempt
+    exe.run(pt.default_main_program(),
+            feed={"x": np.ones((4, 8), np.float32),
+                  "y": np.ones((4, 1), np.float32)}, fetch_list=[])
+    with fault_scope("ckpt.write:" + ",".join(map(str, range(1, 20)))):
+        import pytest as _pytest
+
+        with _pytest.raises(ConnectionError):
+            pt.io.save_sharded(exe, path)
+    assert not [n for n in os.listdir(str(tmp_path))
+                if ".tmp" in n or ".old" in n]
+
+    # the ORIGINAL checkpoint still loads in full
+    for n in saved:
+        scope.set_var(n, np.zeros_like(saved[n]))
+    pt.io.load_sharded(exe, path)
+    for n, v in saved.items():
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), v,
+                                   rtol=1e-6, err_msg=n)

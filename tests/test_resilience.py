@@ -1,0 +1,517 @@
+"""Fault-tolerant runtime: fault injection, retry, versioned checkpoints,
+and the CheckpointedRunner recovery ladder (resilience/).
+
+The core contract under test: with a seeded fault plan firing at the named
+runtime sites, training COMPLETES with bounded retries and the loss
+trajectory is bit-identical to an undisturbed run — recovery must be
+invisible in the numbers, not just in the exit code."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu.resilience import (
+    CheckpointManager,
+    CheckpointedRunner,
+    FaultPlan,
+    InjectedFault,
+    RetryPolicy,
+    fault_point,
+    fault_scope,
+)
+
+
+# -- fault plans --------------------------------------------------------------
+
+
+def test_fault_plan_parse_schedule_and_rand():
+    p = FaultPlan.parse("ckpt.write:2;ps.send:1,4")
+    assert p.schedule == {"ckpt.write": frozenset({2}),
+                          "ps.send": frozenset({1, 4})}
+    r = FaultPlan.parse("rand:p=0.5,seed=3,sites=ps.send|ps.recv,max=2")
+    assert r.p == 0.5 and r.seed == 3 and r.max_faults == 2
+    assert r.sites == frozenset({"ps.send", "ps.recv"})
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.parse("not.a.site:1")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan.parse("rand:p=0.5,sites=bogus")
+    with pytest.raises(ValueError, match="unknown fault-plan key"):
+        FaultPlan.parse("rand:q=1")
+
+
+def test_fault_plan_rand_is_deterministic():
+    a = FaultPlan.parse("rand:p=0.4,seed=11")
+    b = FaultPlan.parse("rand:p=0.4,seed=11")
+    assert [a._draw("ps.send", i) for i in range(64)] == [
+        b._draw("ps.send", i) for i in range(64)]
+    # different sites draw independent streams
+    assert [a._draw("ps.send", i) for i in range(64)] != [
+        a._draw("ps.recv", i) for i in range(64)]
+
+
+def test_fault_scope_fires_on_schedule_and_restores():
+    with fault_scope("ckpt.write:2") as plan:
+        fault_point("ckpt.write")  # hit 1: passes
+        with pytest.raises(InjectedFault) as ei:
+            fault_point("ckpt.write")  # hit 2: fires
+        assert ei.value.site == "ckpt.write" and ei.value.hit == 2
+        assert isinstance(ei.value, ConnectionError)  # travels transport paths
+        fault_point("ckpt.write")  # hit 3: passes again
+        assert plan.stats()["fired"] == [("ckpt.write", 2)]
+    # scope exited: the site is quiet again
+    fault_point("ckpt.write")
+
+
+def test_fault_point_rejects_unknown_site():
+    with fault_scope("rand:p=1.0"):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            fault_point("typo.site")
+
+
+def test_fault_plan_rand_max_faults_bounds_total():
+    with fault_scope("rand:p=1.0,max=3") as plan:
+        fired = 0
+        for _ in range(10):
+            try:
+                fault_point("ps.send")
+            except InjectedFault:
+                fired += 1
+        assert fired == 3
+        assert len(plan.stats()["fired"]) == 3
+
+
+# -- retry policy -------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=4, base_delay=0.001, max_delay=0.002)
+    assert pol.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_does_not_mask_application_errors():
+    pol = RetryPolicy(max_attempts=5, base_delay=0.001)
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise RuntimeError("pserver: no such var")  # server 'err' reply
+
+    with pytest.raises(RuntimeError):
+        pol.call(broken)
+    assert len(calls) == 1  # not transient: no retry
+
+
+def test_retry_exhausts_attempts_and_reraises():
+    pol = RetryPolicy(max_attempts=3, base_delay=0.001)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise EOFError("dead")
+
+    with pytest.raises(EOFError):
+        pol.call(always)
+    assert len(calls) == 3
+
+
+def test_retry_deadline_cuts_backoff_short():
+    slept = []
+    pol = RetryPolicy(max_attempts=50, base_delay=10.0, max_delay=10.0,
+                      deadline=0.5, sleep=slept.append)
+    with pytest.raises(ConnectionError):
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+    assert slept == []  # first 10s backoff already exceeds the 0.5s budget
+
+
+def test_retry_on_retry_hook_and_deterministic_jitter():
+    seen = []
+    pol = RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.5, seed=9,
+                      sleep=lambda d: None)
+    with pytest.raises(ConnectionError):
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                 on_retry=lambda attempt, exc: seen.append(attempt))
+    assert seen == [1, 2]
+    assert pol.delay(1) == RetryPolicy(base_delay=0.001, jitter=0.5,
+                                       seed=9).delay(1)
+
+
+def test_injected_fault_is_retryable():
+    with fault_scope("ps.send:1"):
+        pol = RetryPolicy(max_attempts=2, base_delay=0.001)
+        pol.call(fault_point, "ps.send")  # hit 1 fires, hit 2 passes
+
+
+# -- checkpoint manager -------------------------------------------------------
+
+
+def _train_setup(steps=0, size=4):
+    x = L.data(name="x", shape=[8], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=size), y))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((8, 8)).astype(np.float32)
+    W = rng.standard_normal((8, 1)).astype(np.float32)
+    feed = {"x": X, "y": (X @ W).astype(np.float32)}
+    for _ in range(steps):
+        exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
+    return exe, loss, feed
+
+
+def test_checkpoint_manager_roundtrip_and_latest(tmp_path):
+    exe, loss, feed = _train_setup(steps=2)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_step() is None
+    mgr.save(5, executor=exe)
+    mgr.save(9, executor=exe)
+    assert mgr.steps() == [5, 9] and mgr.latest_step() == 9
+
+    scope = pt.global_scope()
+    before = {n: np.asarray(scope.find_var(n)).copy()
+              for n in scope.var_names()}
+    for n in scope.var_names():
+        scope.set_var(n, np.zeros_like(before[n]))
+    assert mgr.restore(executor=exe) == 9
+    for n, v in before.items():
+        np.testing.assert_array_equal(np.asarray(scope.find_var(n)), v)
+
+
+def test_checkpoint_manager_keep_last_k_gc(tmp_path):
+    exe, loss, feed = _train_setup(steps=1)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=2)
+    for s in range(5):
+        mgr.save(s, executor=exe)
+    assert mgr.steps() == [3, 4]
+
+
+def test_checkpoint_manifest_records_provenance(tmp_path):
+    exe, loss, feed = _train_setup(steps=3)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(3, executor=exe)
+    m = mgr.read_manifest(3)
+    assert m["step"] == 3
+    assert m["rng_counter"] == pt.global_scope()._run_counter
+    assert m["var_names"]  # persistables present
+    # restore puts the RNG run-counter back so counter-derived randomness
+    # continues where the save left off
+    pt.global_scope()._run_counter = 999
+    mgr.restore(executor=exe)
+    assert pt.global_scope()._run_counter == m["rng_counter"]
+
+
+def test_checkpoint_failed_save_leaves_no_half_checkpoint(tmp_path):
+    exe, loss, feed = _train_setup(steps=1)
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(root)
+    mgr.save(1, executor=exe)
+    # fire on every attempt the io retry makes, so the save truly fails
+    with fault_scope("ckpt.write:" + ",".join(map(str, range(1, 20)))):
+        with pytest.raises(ConnectionError):
+            mgr.save(2, executor=exe)
+    # target name never appeared; prior checkpoint intact; no tmp orphans
+    assert mgr.steps() == [1]
+    assert [n for n in os.listdir(root) if n.startswith(".tmp")] == []
+    assert mgr.restore(executor=exe) == 1
+
+
+def test_checkpoint_corrupt_rolls_back_to_last_good(tmp_path):
+    exe, loss, feed = _train_setup(steps=2)
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(root)
+    scope = pt.global_scope()
+    mgr.save(1, executor=exe)
+    good = {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.var_names()}
+    exe.run(pt.default_main_program(), feed=feed, fetch_list=[loss])
+    mgr.save(2, executor=exe)
+    # corrupt the newest manifest
+    with open(os.path.join(root, "step_00000002", "manifest.json"), "w") as f:
+        f.write("{ not json")
+    with pytest.warns(UserWarning, match="quarantined"):
+        assert mgr.restore(executor=exe) == 1
+    for n, v in good.items():
+        np.testing.assert_array_equal(np.asarray(scope.find_var(n)), v)
+    # the corrupt candidate is out of the rotation now
+    assert mgr.steps() == [1]
+
+
+def test_checkpoint_explicit_step_does_not_substitute(tmp_path):
+    exe, loss, feed = _train_setup(steps=1)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(4, executor=exe)
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(step=7, executor=exe)
+
+
+def test_checkpoint_program_hash_mismatch_warns(tmp_path):
+    exe, loss, feed = _train_setup(steps=1)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(0, executor=exe)
+    # a different program resuming from this checkpoint warns loudly
+    main2 = pt.Program()
+    with pt.program_guard(main2, pt.Program()):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[8], dtype="float32")
+            L.fc(x, size=4)
+    with pytest.warns(UserWarning, match="different program"):
+        mgr.restore(executor=exe, main_program=main2)
+
+
+# -- runner: the acceptance contract ------------------------------------------
+
+
+def _runner_feed():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((8, 8)).astype(np.float32)
+    W = rng.standard_normal((8, 1)).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    return lambda step: {"x": X, "y": Y}
+
+
+def _fresh_model():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[8], dtype="float32")
+            y = L.data(name="y", shape=[1], dtype="float32")
+            loss = L.mean(L.square_error_cost(L.fc(x, size=4), y))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _losses(result):
+    return [float(np.asarray(v[0]).reshape(-1)[0])
+            for _, v in sorted(result["results"].items())]
+
+
+def test_runner_faulted_trajectory_bit_identical_to_baseline(tmp_path):
+    """Faults at executor.compile, collective.step and ckpt.write; the run
+    completes with bounded retries and the loss trajectory matches an
+    undisturbed run EXACTLY (restore-and-replay + step-keyed RNG)."""
+    feed_fn = _runner_feed()
+    main, startup, loss = _fresh_model()
+    exe = pt.Executor()
+    exe.run(startup, scope=pt.global_scope())
+    mgr = CheckpointManager(str(tmp_path / "faulted"), keep_last_k=3)
+    runner = CheckpointedRunner(exe, mgr, main_program=main, save_every=2,
+                                max_retries=5)
+    plan_spec = "executor.compile:1;collective.step:4;ckpt.write:2"
+    with fault_scope(plan_spec) as plan:
+        out = runner.run(feed_fn, 6, fetch_list=[loss])
+    fired_sites = {s for s, _ in plan.stats()["fired"]}
+    assert fired_sites == {"executor.compile", "collective.step",
+                           "ckpt.write"}, plan.stats()
+    assert 0 < out["retries"] <= runner.max_retries * 6
+    assert mgr.latest_step() == 5
+
+    # baseline: same model in a fresh scope, no faults
+    main2, startup2, loss2 = _fresh_model()
+    with pt.scope_guard(pt.Scope()):
+        exe2 = pt.Executor()
+        exe2.run(startup2, scope=pt.global_scope())
+        base = CheckpointedRunner(
+            exe2, CheckpointManager(str(tmp_path / "base")),
+            main_program=main2, save_every=2).run(feed_fn, 6,
+                                                  fetch_list=[loss2])
+    assert base["retries"] == 0
+    assert _losses(out) == _losses(base)
+
+
+def test_runner_resumes_from_latest_checkpoint(tmp_path):
+    feed_fn = _runner_feed()
+    main, startup, loss = _fresh_model()
+    exe = pt.Executor()
+    exe.run(startup)
+    root = str(tmp_path / "ck")
+    r1 = CheckpointedRunner(exe, root, main_program=main, save_every=1)
+    first = r1.run(feed_fn, 3, fetch_list=[loss])
+    # "new process": fresh scope, params zeroed — resume must restore
+    with pt.scope_guard(pt.Scope()):
+        exe2 = pt.Executor()
+        exe2.run(startup)
+        r2 = CheckpointedRunner(exe2, root, main_program=main, save_every=1)
+        second = r2.run(feed_fn, 6, fetch_list=[loss])
+    assert second["start_step"] == 3
+    assert sorted(second["results"]) == [3, 4, 5]
+
+    # undisturbed 6-step baseline for comparison
+    main2, startup2, loss2 = _fresh_model()
+    with pt.scope_guard(pt.Scope()):
+        exe3 = pt.Executor()
+        exe3.run(startup2)
+        base = CheckpointedRunner(
+            exe3, str(tmp_path / "base"), main_program=main2,
+            save_every=1).run(feed_fn, 6, fetch_list=[loss2])
+    assert _losses(first) + _losses(second) == _losses(base)
+
+
+def test_runner_surfaces_persistent_failure_with_bounded_attempts(tmp_path):
+    from paddle_tpu.resilience.runner import StepFailure
+
+    feed_fn = _runner_feed()
+    main, startup, loss = _fresh_model()
+    exe = pt.Executor()
+    exe.run(startup)
+    runner = CheckpointedRunner(exe, str(tmp_path / "ck"), main_program=main,
+                                save_every=1, max_retries=3)
+    # collective.step fires on every hit: the step can never succeed
+    with fault_scope("collective.step:" + ",".join(map(str, range(1, 60)))):
+        with pytest.raises(StepFailure) as ei:
+            runner.run(feed_fn, 2, fetch_list=[loss])
+    assert ei.value.attempts == 4  # max_retries exceeded by exactly one
+
+
+def test_runner_invalidates_compile_cache_on_second_failure(tmp_path):
+    feed_fn = _runner_feed()
+    main, startup, loss = _fresh_model()
+    exe = pt.Executor()
+    exe.run(startup)
+    calls = []
+    orig = exe.invalidate_cache
+    exe.invalidate_cache = lambda p=None: (calls.append(1), orig(p))[1]
+    runner = CheckpointedRunner(exe, str(tmp_path / "ck"), main_program=main,
+                                save_every=1, max_retries=5)
+    # two consecutive step faults on the same step: rung 2 must invalidate
+    with fault_scope("collective.step:2,3"):
+        out = runner.run(feed_fn, 3, fetch_list=[loss])
+    assert calls  # the second failure reached the invalidation rung
+    assert sorted(out["results"]) == [0, 1, 2]
+
+
+def test_executor_invalidate_cache_recompiles(tmp_path):
+    exe, loss, feed = _train_setup(steps=1)
+    main = pt.default_main_program()
+    assert main in exe._cache
+    exe.invalidate_cache(main)
+    assert main not in exe._cache
+    (lv,) = exe.run(main, feed=feed, fetch_list=[loss])  # recompiles fine
+    assert np.isfinite(lv).all()
+
+
+# -- ps rpc sites: client-level retry absorbs injected wire faults ------------
+
+
+def _serve_one_param(ep, value):
+    from paddle_tpu.distributed.ps_rpc import PServerRuntime
+    from paddle_tpu.executor import Executor, Scope
+
+    scope = Scope()
+    scope.set_var("w", value)
+    srv = PServerRuntime(ep, n_trainers=1, sync_mode=False, blocks=[],
+                         scope=scope, executor=Executor())
+    t = threading.Thread(target=srv.serve, daemon=True)
+    t.start()
+    return srv, t
+
+
+def test_runner_completes_ps_training_under_faults_at_every_site(tmp_path):
+    """The acceptance contract: one seeded plan injecting at least one
+    failure at EVERY named site; a CheckpointedRunner driving a transpiled
+    pserver trainer program completes training with bounded retries.
+
+    The pserver runs as a subprocess (dist_simple.py pattern) so the
+    in-process fault counters see only the trainer's hits and the schedule
+    stays deterministic."""
+    import socket
+    import subprocess
+    import sys
+
+    import dist_simple as ds
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ps = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tests", "dist_simple.py"),
+         "pserver", ep, "0", "1", str(tmp_path / "ps.npz"), ep],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    try:
+        main_p, startup = pt.Program(), pt.Program()
+        main_p.random_seed = 7
+        startup.random_seed = 7
+        with pt.program_guard(main_p, startup):
+            with pt.unique_name.guard():
+                loss = ds.build()
+                pt.optimizer.SGD(0.1).minimize(loss)
+        t = pt.DistributeTranspiler()
+        t.transpile(0, program=main_p, pservers=ep, trainers=1,
+                    sync_mode=True, startup_program=startup)
+        exe = pt.Executor()
+        exe.run(startup)
+        prog = t.get_trainer_program()
+        x, y = ds.full_data()
+        runner = CheckpointedRunner(
+            exe, CheckpointManager(str(tmp_path / "ck"), keep_last_k=2),
+            main_program=prog, save_every=2, max_retries=5)
+        plan_spec = ("ps.send:2;ps.recv:3;collective.step:3;"
+                     "executor.compile:1;ckpt.write:1")
+        with fault_scope(plan_spec) as plan:
+            out = runner.run(lambda step: {"x": x, "y": y}, 5,
+                             fetch_list=[loss.name])
+        exe.close()
+    finally:
+        if ps.poll() is None:
+            try:
+                out_ps, _ = ps.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                ps.kill()
+                out_ps, _ = ps.communicate()
+        else:
+            out_ps, _ = ps.communicate()
+    assert ps.returncode == 0, out_ps.decode()[-3000:]
+
+    stats = plan.stats()
+    fired = {site for site, _ in stats["fired"]}
+    assert fired == {"ps.send", "ps.recv", "collective.step",
+                     "executor.compile", "ckpt.write"}, stats
+    assert sorted(out["results"]) == [0, 1, 2, 3, 4]
+    assert 0 < out["retries"] <= runner.max_retries * 5
+    losses = _losses(out)
+    assert losses[-1] < losses[0], losses
+
+
+def test_ps_client_retries_injected_send_and_recv_faults():
+    import socket
+
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    w0 = np.arange(4, dtype=np.float32)
+    srv, t = _serve_one_param(ep, w0)
+    client = PSClient([ep], trainer_id=0)
+    try:
+        with fault_scope("ps.send:1;ps.recv:1") as plan:
+            client.send_var(ep, "w", np.ones(4, np.float32))
+            got = client.get_var(ep, "w")
+        np.testing.assert_array_equal(got, w0)  # no optimize block: unchanged
+        stats = plan.stats()
+        # both sites fired once and the retry absorbed them
+        assert {s for s, _ in stats["fired"]} == {"ps.send", "ps.recv"}
+        assert stats["hits"]["ps.send"] >= 2 and stats["hits"]["ps.recv"] >= 2
+    finally:
+        client.send_complete()
+        client.close()
+        t.join(timeout=10)
