@@ -30,6 +30,8 @@ from . import distributed  # noqa: F401
 from . import inference  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import resilience  # noqa: F401
+from . import pipeline  # noqa: F401
+from .pipeline import DeviceLoader  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401

@@ -4,23 +4,75 @@ Reference: /root/reference/python/paddle/fluid/data_feeder.py (DataFeeder:48,
 DataToLoDTensorConverter:27). The reference builds LoDTensors for ragged
 sequences; XLA needs static shapes, so ragged fields are padded to the batch
 max (plus an optional companion '<name>_len' length vector replacing LoD —
-SURVEY.md §5 long-context notes)."""
+SURVEY.md §5 long-context notes).
+
+Shape bucketing (FLAGS_feed_bucketing or an explicit bucket_size): the
+executor compiles one XLA executable per exact feed-shape signature, so the
+ragged tail batch of every epoch — and every distinct padded sequence length —
+is a fresh multi-second compile. Bucketing rounds those shapes to a small set:
+  * the batch dim pads up to the bucket size (explicit bucket_size, else the
+    largest batch seen so far) with zero rows;
+  * ragged sample dims round up to the next power of two;
+  * a float32 [bucket, 1] row mask lands in the feed under ROW_MASK_NAME
+    (1.0 real row / 0.0 padding). Loss/metric ops must honor it for exact
+    numerics: `sum(per_row * mask) / sum(mask)` reproduces the unpadded
+    result bit-for-bit on the real rows (tests/test_async_pipeline.py).
+"""
 from __future__ import annotations
 
 import numpy as np
 
+from . import flags
 from .framework import Variable
 
-__all__ = ["DataFeeder"]
+__all__ = ["DataFeeder", "ROW_MASK_NAME", "pad_feed_to_bucket"]
+
+# the row-mask convention shared by DataFeeder and the Dataset runtime: any
+# program that wants exact numerics under bucketing declares a data var with
+# this name, shape [1], dtype float32, and weights its per-row losses by it
+ROW_MASK_NAME = "batch_mask"
+
+
+def pad_feed_to_bucket(feed: dict, bucket: int,
+                       mask_name: str = ROW_MASK_NAME) -> dict:
+    """Pad every array's leading (batch) dim up to `bucket` rows with zeros
+    and attach the [bucket, 1] float32 row mask. Always emits the mask — a
+    feed whose key set changes between full and ragged batches would defeat
+    the compile-cache hit bucketing exists for."""
+    rows = None
+    out = {}
+    for name, v in feed.items():
+        arr = np.asarray(v)
+        if rows is None:
+            rows = arr.shape[0]
+        if arr.shape[0] < bucket:
+            pad = np.zeros((bucket - arr.shape[0],) + arr.shape[1:], arr.dtype)
+            arr = np.concatenate([arr, pad])
+        out[name] = arr
+    mask = np.zeros((bucket, 1), np.float32)
+    mask[:rows if rows is not None else bucket] = 1.0
+    out[mask_name] = mask
+    return out
+
+
+def _round_up_pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
 
 
 class DataFeeder:
     def __init__(self, feed_list, place=None, program=None, pad_ragged=True,
-                 emit_lengths=False):
+                 emit_lengths=False, bucket_size=None,
+                 mask_name=ROW_MASK_NAME):
         self.feed_vars: list[Variable] = list(feed_list)
         self.place = place
         self.pad_ragged = pad_ragged
         self.emit_lengths = emit_lengths
+        self.bucket_size = bucket_size
+        self.mask_name = mask_name
+        self._bucket_hwm = 0  # largest batch seen; the implicit bucket size
+
+    def _bucketing(self) -> bool:
+        return self.bucket_size is not None or flags.get_flag("feed_bucketing")
 
     def feed(self, iterable) -> dict:
         """iterable: list of samples; each sample is a tuple/list with one
@@ -28,6 +80,7 @@ class DataFeeder:
         samples = list(iterable)
         if not samples:
             raise ValueError("DataFeeder.feed got an empty batch")
+        bucketing = self._bucketing()
         out = {}
         for i, var in enumerate(self.feed_vars):
             cols = [np.asarray(s[i]) for s in samples]
@@ -36,7 +89,7 @@ class DataFeeder:
             if len(shapes) == 1:
                 arr = np.stack(cols).astype(dtype, copy=False)
             elif self.pad_ragged:
-                arr = _pad_stack(cols, dtype)
+                arr = _pad_stack(cols, dtype, round_ragged=bucketing)
                 if self.emit_lengths:
                     out[var.name + "_len"] = np.asarray(
                         [c.shape[0] for c in cols], np.int64)
@@ -48,12 +101,22 @@ class DataFeeder:
             if arr.ndim == want_rank - 1:
                 arr = arr[..., None]
             out[var.name] = arr
+        if bucketing:
+            self._bucket_hwm = max(self._bucket_hwm, len(samples))
+            bucket = max(self.bucket_size or 0, self._bucket_hwm)
+            out = pad_feed_to_bucket(out, bucket, self.mask_name)
         return out
 
 
-def _pad_stack(cols, dtype):
+def _pad_stack(cols, dtype, round_ragged=False):
     rank = cols[0].ndim
     maxes = [max(c.shape[d] for c in cols) for d in range(rank)]
+    if round_ragged:
+        # bucket ragged dims to the next power of two so consecutive batches
+        # with nearby max lengths share one compiled signature; uniform dims
+        # keep their exact extent (they are part of the model's shape)
+        maxes = [_round_up_pow2(m) if len({c.shape[d] for c in cols}) > 1
+                 else m for d, m in enumerate(maxes)]
     out = np.zeros([len(cols)] + maxes, dtype)
     for i, c in enumerate(cols):
         sl = tuple(slice(0, s) for s in c.shape)

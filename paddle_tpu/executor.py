@@ -24,6 +24,8 @@ the TPU-native equivalent of the reference's per-op seed attrs.
 """
 from __future__ import annotations
 
+import collections
+import time
 import weakref
 from typing import Any, Sequence
 
@@ -31,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import flags
+from . import flags, profiler
 from .framework import OpError, Program, Variable, default_main_program
 from .ops.registry import ExecContext, get_op_def
 from .resilience.faults import fault_point
@@ -137,6 +139,9 @@ class _Compiled:
         # set when the mesh spans multiple processes: (feed, ro, rw)
         # NamedShardings used to lift host values to global arrays
         self.global_shardings = None
+        # mesh programs: {feed name: NamedSharding} for the DeviceLoader
+        # prefetcher, so staged batches already carry the entry's layout
+        self.feed_shardings = None
 
 
 def _has_host_ops(block) -> bool:
@@ -210,6 +215,22 @@ def _analyze_block(block, feed_names: list[str], scope: Scope):
     return ro, rw, extra_w
 
 
+def _step_token(*groups):
+    """Cheap scalar that completes exactly when the step's outputs do — the
+    async-window handle. It cannot be a state array itself: the NEXT step
+    donates those buffers, so a retained reference would be deleted before
+    the window drains it. A fresh 1-element reduction over the first entry
+    of every output leaf is never donated and costs nothing."""
+    tok = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(groups):
+        if getattr(leaf, "size", 0):
+            v = jnp.ravel(leaf)[0]
+            if jnp.iscomplexobj(v):
+                v = jnp.real(v)
+            tok = tok + v.astype(jnp.float32)
+    return tok
+
+
 def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env=None):
     ops = [op for op in block.ops if op.type not in _SKIP_OPS]
 
@@ -248,7 +269,8 @@ def _lower(block, feed_names, ro_names, rw_names, extra_w, fetch_names, axis_env
         fetches = tuple(env[n] for n in fetch_names)
         new_rw = tuple(env[n] for n in rw_names)
         new_extra = tuple(env[n] for n in extra_w)
-        return fetches, new_rw, new_extra
+        return fetches, new_rw, new_extra, _step_token(fetches, new_rw,
+                                                       new_extra)
 
     return fn
 
@@ -345,7 +367,9 @@ class _SegmentedFn:
         fetches = tuple(env[n] for n in self.fetch)
         new_rw = tuple(env[n] for n in self.rw)
         new_extra = tuple(env[n] for n in self.extra)
-        return fetches, new_rw, new_extra
+        # host-op programs execute synchronously segment by segment — there
+        # is no async step to bound, so no completion token
+        return fetches, new_rw, new_extra, None
 
 
 def _run_ops_traced(block, env, key=None):
@@ -408,6 +432,9 @@ class Executor:
         self.place = place
         # program -> {signature: _Compiled}
         self._cache: "weakref.WeakKeyDictionary[Program, dict]" = weakref.WeakKeyDictionary()
+        # completion tokens of dispatched-but-undrained async steps
+        # (run_async window, bounded by FLAGS_max_inflight_steps)
+        self._inflight: collections.deque = collections.deque()
 
     # -- public API ---------------------------------------------------------
     def run(
@@ -424,6 +451,53 @@ class Executor:
         random_seed and an op prefix draw IDENTICAL per-op keys when given
         the same counter — how the pipeline backward replay reproduces the
         forward's dropout masks exactly (parallel/pipeline.py)."""
+        outs, _ = self._run_impl(program, feed, fetch_list, scope,
+                                 return_numpy, rng_counter)
+        return outs
+
+    def run_async(
+        self,
+        program: Program | None = None,
+        feed: dict | None = None,
+        fetch_list: Sequence | None = None,
+        scope: Scope | None = None,
+        rng_counter: int | None = None,
+    ):
+        """Dispatch one step and return DEVICE-ARRAY fetch handles — no host
+        sync. The returned arrays materialize on first np.asarray (a deferred
+        fetch); state updates chain forward through the scope exactly as with
+        run(), including buffer donation.
+
+        Runahead is bounded: each dispatch enqueues the step's completion
+        token, and once more than FLAGS_max_inflight_steps tokens are
+        pending the host blocks on the OLDEST one — the only place the async
+        trainer loop ever waits on the device (window boundary drain)."""
+        outs, token = self._run_impl(program, feed, fetch_list, scope,
+                                     False, rng_counter)
+        if token is not None:
+            self._inflight.append(token)
+            window = int(flags.get_flag("max_inflight_steps"))
+            if window > 0:
+                while len(self._inflight) > window:
+                    with profiler.stage_timer("pipeline.window_drain"):
+                        jax.block_until_ready(self._inflight.popleft())
+        return outs
+
+    def wait(self):
+        """Block until every run_async step dispatched so far has completed
+        on the device (epoch boundary / before reading trained state)."""
+        while self._inflight:
+            jax.block_until_ready(self._inflight.popleft())
+
+    def _run_impl(
+        self,
+        program: Program | None,
+        feed: dict | None,
+        fetch_list: Sequence | None,
+        scope: Scope | None,
+        return_numpy: bool,
+        rng_counter: int | None,
+    ):
         from .compiler import CompiledProgram  # lazy; avoids cycle
 
         mesh = None
@@ -446,7 +520,8 @@ class Executor:
                     "combining PipelineOptimizer with a CompiledProgram mesh "
                     "is not supported yet — run the pipeline program "
                     "directly (dp-sharding inside stages is planned)")
-            return program._pipeline.run_step(self, scope, feed, fetch_names)
+            return program._pipeline.run_step(self, scope, feed,
+                                              fetch_names), None
 
         from .core.selected_rows import is_selected_rows
 
@@ -532,7 +607,7 @@ class Executor:
             # disable_jit, so per-op attribution is unavailable — fall back to
             # a whole-step output check below.
             with jax.disable_jit():
-                fetches, new_rw, new_extra = comp.fn(
+                fetches, new_rw, new_extra, token = comp.fn(
                     tuple(feed_vals), ro_vals, rw_vals, key)
             if getattr(comp, "spmd_mode", "gspmd") == "shard_map":
                 for group, names in ((fetches, comp.fetch_names),
@@ -545,8 +620,11 @@ class Executor:
                                 f"'{n}' (per-op attribution is unavailable "
                                 f"under shard_map/with_collective)")
         else:
-            fetches, new_rw, new_extra = comp.fn(
+            t_dispatch = time.perf_counter()
+            fetches, new_rw, new_extra, token = comp.fn(
                 tuple(feed_vals), ro_vals, rw_vals, key)
+            profiler.record_stage("pipeline.dispatch",
+                                  time.perf_counter() - t_dispatch)
         if flags.get_flag("benchmark"):
             jax.block_until_ready((fetches, new_rw))  # reference operator.cc:926
 
@@ -556,8 +634,8 @@ class Executor:
             scope.set_var(n, v)
 
         if return_numpy:
-            return [np.asarray(x) for x in fetches]
-        return list(fetches)
+            return [np.asarray(x) for x in fetches], token
+        return list(fetches), token
 
     def train_from_dataset(
         self,
@@ -615,7 +693,12 @@ class Executor:
 
     def _run_from_dataset(self, program, dataset, scope, debug, fetch_list,
                           fetch_info, print_period):
-        import time as _time
+        """The async trainer loop: batches flow through the DeviceLoader
+        prefetcher (transfer overlaps compute), each step dispatches through
+        run_async (the host never blocks except at FLAGS_max_inflight_steps
+        window boundaries), and fetched values stay device arrays until a
+        print period actually reads them."""
+        from .pipeline import DeviceLoader
 
         fetch_list = fetch_list or []
         names = [v.name if isinstance(v, Variable) else str(v)
@@ -625,19 +708,99 @@ class Executor:
                 f"fetch_info has {len(fetch_info)} entries for "
                 f"{len(names)} fetch_list variables")
         labels = list(fetch_info or names)
-        t0 = _time.perf_counter()
+        depth = int(flags.get_flag("device_prefetch_depth"))
+        if depth > 0:
+            batches = iter(DeviceLoader(dataset._iter_batches, depth=depth,
+                                        placement=self.feed_placer(program)))
+        else:
+            batches = dataset._iter_batches()
+        t0 = None
         n_batches = 0
-        for feed in dataset._iter_batches():
-            outs = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-            n_batches += 1
-            if (debug or names) and n_batches % print_period == 0:
-                msg = ", ".join(
-                    f"{lbl}: {np.asarray(o).reshape(-1)[:8]}"
-                    for lbl, o in zip(labels, outs))
-                dt = _time.perf_counter() - t0
-                print(f"batch {n_batches} ({n_batches / dt:.1f} batch/s) "
-                      f"{msg}", flush=True)
+        try:
+            for feed in batches:
+                outs = self.run_async(program, feed=feed,
+                                      fetch_list=fetch_list, scope=scope)
+                n_batches += 1
+                if n_batches == 1:
+                    # the first batch carries the XLA compile: let it finish
+                    # and start the throughput window AFTER it, so the
+                    # reported batch/s measures steady state, not compilation
+                    self.wait()
+                    t0 = time.perf_counter()
+                    continue
+                if (debug or names) and n_batches % print_period == 0:
+                    msg = ", ".join(
+                        f"{lbl}: {np.asarray(o).reshape(-1)[:8]}"
+                        for lbl, o in zip(labels, outs))
+                    dt = time.perf_counter() - t0
+                    rate = (n_batches - 1) / dt if dt > 0 else float("inf")
+                    print(f"batch {n_batches} ({rate:.1f} batch/s) "
+                          f"{msg}", flush=True)
+        finally:
+            # epoch boundary: drain the window so trained state is final
+            # before the dataset's _finish_to_run hook (and so an exception
+            # doesn't leave steps silently in flight)
+            self.wait()
+
+    def feed_placer(self, program=None):
+        """Placement fn for the DeviceLoader prefetcher: cast host batches to
+        their declared var dtypes (the same cast run() applies, so the
+        compile-cache signature matches) and stage them into device memory.
+        Once a compiled entry for this feed-name set exists, staged arrays
+        carry its feed shardings; on a multi-process mesh the local shard is
+        lifted to a global array via make_array_from_process_local_data."""
+        from .compiler import CompiledProgram
+        from .core.selected_rows import is_selected_rows
+
+        mesh = None
+        prog = program
+        if isinstance(prog, CompiledProgram):
+            mesh = prog._mesh
+            prog = prog._program
+        if prog is None:
+            prog = default_main_program()
+        block = prog.global_block
+        multiproc = _spans_processes(mesh)
+
+        def place(feed: dict) -> dict:
+            names = sorted(feed)
+            comp = None
+            cache = self._cache.get(prog)
+            if cache:
+                for c in reversed(list(cache.values())):
+                    if list(c.feed_names) == names:
+                        comp = c
+                        break
+            out = {}
+            for n in names:
+                v = feed[n]
+                if is_selected_rows(v):
+                    out[n] = v
+                    continue
+                if not isinstance(v, jax.Array):
+                    v = np.asarray(v)
+                    try:
+                        v = v.astype(block.var(n).np_dtype, copy=False)
+                    except KeyError:
+                        pass
+                sh = comp.feed_shardings.get(n) if (
+                    comp is not None and comp.feed_shardings) else None
+                t0 = time.perf_counter()
+                if sh is not None:
+                    out[n] = _to_global(v, sh) if multiproc \
+                        else jax.device_put(v, sh)
+                elif mesh is None:
+                    out[n] = v if isinstance(v, jax.Array) \
+                        else jax.device_put(v)
+                else:
+                    # mesh program before its first compile: leave the batch
+                    # on host; run() places it and later batches get staged
+                    out[n] = v
+                profiler.record_stage("pipeline.device_put",
+                                      time.perf_counter() - t0)
+            return out
+
+        return place
 
     def invalidate_cache(self, program=None):
         """Drop compiled executables for `program` (or all programs).
@@ -758,6 +921,7 @@ class Executor:
                 tuple(P() for _ in fetch_names),
                 tuple(P() for _ in rw_names),
                 tuple(P() for _ in extra_w),
+                P(),  # async completion token
             )
             try:
                 sfn = _shard_map(
@@ -770,11 +934,13 @@ class Executor:
             jfn = jax.jit(sfn, donate_argnums=(2,))
             comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
             comp.extra_w = extra_w
-            if _spans_processes(mesh):
-                from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding
 
+            comp.feed_shardings = {
+                n: NamedSharding(mesh, _feed_spec(n)) for n in feed_names}
+            if _spans_processes(mesh):
                 comp.global_shardings = (
-                    tuple(NamedSharding(mesh, _feed_spec(n)) for n in feed_names),
+                    tuple(comp.feed_shardings[n] for n in feed_names),
                     tuple(NamedSharding(mesh, P()) for _ in ro_names),
                     tuple(NamedSharding(mesh, P()) for _ in rw_names),
                 )
@@ -794,6 +960,8 @@ class Executor:
         jfn = jax.jit(fn, **jit_kwargs)
         comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
         comp.extra_w = extra_w
-        if in_sh is not None and _spans_processes(mesh):
-            comp.global_shardings = in_sh[:3]
+        if in_sh is not None:
+            comp.feed_shardings = dict(zip(feed_names, in_sh[0]))
+            if _spans_processes(mesh):
+                comp.global_shardings = in_sh[:3]
         return comp

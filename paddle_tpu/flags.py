@@ -85,6 +85,22 @@ _define("communicator_min_send_grad_num_before_recv", 20,
         "grads sent before the recv thread starts pulling params")
 _define("communicator_send_wait_times", 5,
         "short waits the send thread spends collecting grads to merge")
+# async feed/dispatch pipeline knobs (pipeline/, executor.run_async)
+_define("max_inflight_steps", 4,
+        "Executor.run_async window: dispatched-but-undrained steps allowed "
+        "before the host blocks on the oldest step's completion token. "
+        "1 = fully synchronous per step; <=0 = unbounded runahead")
+_define("device_prefetch_depth", 2,
+        "DeviceLoader: batches staged into device memory ahead of the "
+        "consumer by the background transfer thread (train_from_dataset "
+        "and PyReader use_double_buffer); <=0 disables device prefetch in "
+        "train_from_dataset")
+_define("feed_bucketing", False,
+        "pad ragged tail batches up to the bucket size (DataFeeder bucket / "
+        "Dataset batch_size) and attach a '<batch_mask>' row-mask feed so "
+        "the (program, feed-signature) compile cache is hit instead of "
+        "recompiling the last batch of every epoch; loss/metric ops must "
+        "honor the mask for exact numerics (see README)")
 # resilience runtime knobs (resilience/: faults, retry, checkpoint, runner)
 _define("fault_plan", "",
         "deterministic fault-injection plan for the named runtime sites "

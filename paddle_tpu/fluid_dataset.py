@@ -88,7 +88,14 @@ class DatasetBase:
         return ws
 
     def _split_batch(self, flat: np.ndarray) -> dict:
-        """[B, sum(widths)] float64 -> {var name: [B, *shape] typed array}."""
+        """[B, sum(widths)] float64 -> {var name: [B, *shape] typed array}.
+
+        Under FLAGS_feed_bucketing the ragged tail batch of an epoch is
+        padded up to batch_size with zero rows and the feed gains the
+        float32 row mask (data_feeder.ROW_MASK_NAME) — every batch of the
+        epoch then shares ONE compiled signature instead of the tail
+        triggering a fresh XLA compile. Programs that must be exact under
+        padding weight their per-row losses by the mask."""
         feed = {}
         off = 0
         for v, w in zip(self.use_vars, self._widths()):
@@ -97,6 +104,12 @@ class DatasetBase:
             shape = [d for d in v.shape if d not in (-1, None)]
             arr = part.reshape([part.shape[0]] + [int(d) for d in shape])
             feed[v.name] = arr.astype(v.np_dtype, copy=False)
+        from . import flags
+
+        if flags.get_flag("feed_bucketing"):
+            from .data_feeder import pad_feed_to_bucket
+
+            feed = pad_feed_to_bucket(feed, self.batch_size)
         return feed
 
     def _parse_file(self, path: str) -> np.ndarray:

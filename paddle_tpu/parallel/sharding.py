@@ -77,5 +77,6 @@ def build_shardings(mesh, block, feed_names, ro_names, rw_names, extra_w, fetch_
     fetch_sh = tuple(NamedSharding(mesh, P()) for _ in fetch_names)
     new_rw_sh = rw_sh
     extra_sh = tuple(var_sharding(mesh, _var(n), False) for n in extra_w)
-    out_sh = (fetch_sh, new_rw_sh, extra_sh)
+    # 4th output: the scalar async completion token (executor._step_token)
+    out_sh = (fetch_sh, new_rw_sh, extra_sh, NamedSharding(mesh, P()))
     return in_sh, out_sh

@@ -1,0 +1,13 @@
+"""Async input pipeline: device prefetch + compile accounting.
+
+TPU-native analogue of the reference's buffered double-buffer reader
+(/root/reference/paddle/fluid/operators/reader/buffered_reader.cc): a
+background thread stages the next K batches into device memory so the
+host->HBM transfer overlaps the running step, and the executor's async
+dispatch window (Executor.run_async + FLAGS_max_inflight_steps) keeps the
+XLA stream fed without unbounded host runahead.
+"""
+from .compile_counter import jit_compile_counter  # noqa: F401
+from .device_loader import DeviceLoader, default_placement  # noqa: F401
+
+__all__ = ["DeviceLoader", "default_placement", "jit_compile_counter"]

@@ -1,0 +1,71 @@
+"""Compile-count hook: observe XLA compiles of the executor's step function.
+
+The executor caches one compiled executable per (program, feed-signature);
+feed bucketing exists precisely so a ragged tail batch hits that cache
+instead of triggering a fresh compile. This hook turns "how many compiles
+actually happened" into something a regression test can assert: it enables
+jax's log_compiles reporting and counts the whole-block compile events (the
+executor's lowered closure is named `fn`, so its compile log lines are
+distinguishable from the small utility jits jax compiles around a run).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+
+import jax
+
+__all__ = ["jit_compile_counter"]
+
+# loggers that announce "Compiling <name> ..." under jax_log_compiles; the
+# module moved across jax versions, so listen on both spellings
+_COMPILE_LOGGERS = (
+    "jax._src.interpreters.pxla",
+    "jax.interpreters.pxla",
+)
+
+
+class _CompileCount:
+    def __init__(self):
+        self.events: list[str] = []
+
+    @property
+    def count(self) -> int:
+        return len(self.events)
+
+
+@contextlib.contextmanager
+def jit_compile_counter(fn_name: str = "fn"):
+    """Count XLA compiles of jitted functions named `fn_name` inside the
+    `with` block. Default "fn" matches the executor's whole-block closure, so
+    `counter.count` is the number of (program, signature) compile-cache
+    misses the block produced."""
+    result = _CompileCount()
+    prefix = f"Compiling {fn_name} "
+
+    class _Handler(logging.Handler):
+        def emit(self, record):
+            msg = record.getMessage()
+            if msg.startswith(prefix):
+                result.events.append(msg)
+
+    handler = _Handler(level=logging.DEBUG)
+    touched = []
+    for name in _COMPILE_LOGGERS:
+        logger = logging.getLogger(name)
+        logger.addHandler(handler)
+        # the compile announcement is logged at WARNING; make sure an
+        # application logging config set above WARNING doesn't eat it
+        old_level = logger.level
+        if logger.getEffectiveLevel() > logging.WARNING:
+            logger.setLevel(logging.WARNING)
+        touched.append((logger, old_level))
+    old_flag = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    try:
+        yield result
+    finally:
+        jax.config.update("jax_log_compiles", old_flag)
+        for logger, old_level in touched:
+            logger.removeHandler(handler)
+            logger.setLevel(old_level)

@@ -12,13 +12,15 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import time
 
 import jax
 
 from . import flags
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
-           "record_event"]
+           "record_event", "record_stage", "stage_timer", "stage_counters"]
 
 
 def _resolve_dir(path: str | None) -> str:
@@ -71,3 +73,40 @@ class RecordEvent(contextlib.ContextDecorator):
 
 
 record_event = RecordEvent
+
+
+# -- pipeline stage counters --------------------------------------------------
+# Cheap always-on accumulators for the async feed/dispatch pipeline (host
+# ingest / device transfer / dispatch / window drain). Unlike the XPlane
+# trace these need no viewer: tools/_pipeline_ab.py and ad-hoc debugging read
+# them directly to see which stage the end-to-end path is losing time to.
+_stage_lock = threading.Lock()
+_stage_counters: dict[str, list] = {}  # stage -> [events, seconds]
+
+
+def record_stage(stage: str, seconds: float, events: int = 1):
+    """Accumulate `seconds` of wall time against a named pipeline stage."""
+    with _stage_lock:
+        c = _stage_counters.setdefault(stage, [0, 0.0])
+        c[0] += events
+        c[1] += seconds
+
+
+@contextlib.contextmanager
+def stage_timer(stage: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_stage(stage, time.perf_counter() - t0)
+
+
+def stage_counters(reset: bool = False) -> dict:
+    """Snapshot {stage: {"events": n, "seconds": s}}; reset=True zeroes the
+    accumulators after reading (epoch-scoped measurements)."""
+    with _stage_lock:
+        snap = {k: {"events": v[0], "seconds": v[1]}
+                for k, v in _stage_counters.items()}
+        if reset:
+            _stage_counters.clear()
+    return snap

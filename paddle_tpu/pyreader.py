@@ -4,10 +4,14 @@ Reference: /root/reference/python/paddle/fluid/reader.py (PyReader:47) +
 operators/reader/buffered_reader.cc (host->device double buffering) +
 lod_tensor_blocking_queue.h. TPU re-design: one python background thread
 fills a bounded queue with ready feed dicts (the LoDTensorBlockingQueue
-equivalent); device transfer overlaps compute because jit dispatch is async —
-XLA owns the actual double buffering. `iterable=True` mode only (the
-start/reset in-program reader-op protocol has no XLA analogue; the reference
-itself deprecated it)."""
+equivalent); with use_double_buffer=True (the default, and the reference's
+buffered_reader) a second background thread — pipeline.DeviceLoader — stages
+the next FLAGS_device_prefetch_depth batches into device memory with
+jax.device_put, so the host->HBM transfer overlaps the running step.
+use_double_buffer=False keeps the plain host-queue prefetch (batches reach
+the consumer as numpy and Executor.run places them synchronously).
+`iterable=True` mode only (the start/reset in-program reader-op protocol has
+no XLA analogue; the reference itself deprecated it)."""
 from __future__ import annotations
 
 from .data_feeder import DataFeeder
@@ -25,6 +29,7 @@ class PyReader:
                 "the TPU build; iterate the reader object instead")
         self.feed_list = feed_list
         self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
         self.return_list = return_list
         self._feeder = DataFeeder(feed_list) if feed_list else None
         self._source = None  # callable -> generator of feed dicts
@@ -68,7 +73,19 @@ class PyReader:
     def __iter__(self):
         if self._source is None:
             raise RuntimeError("decorate_* must be called before iterating")
-        for d in _prefetch_iter(self._source, self.capacity):
+        if self.use_double_buffer:
+            from .pipeline import DeviceLoader
+
+            # two stages, mirroring the reference's queue + buffered_reader:
+            # the host queue (capacity) absorbs reader jitter cheaply in
+            # numpy; the DeviceLoader holds only a few batches in HBM
+            source, capacity = self._source, self.capacity
+            it = iter(DeviceLoader(
+                lambda: _prefetch_iter(source, capacity),
+                feed_vars=self.feed_list))
+        else:
+            it = _prefetch_iter(self._source, self.capacity)
+        for d in it:
             if self.return_list:
                 yield [d[v.name] for v in self.feed_list]
             else:

@@ -1,19 +1,30 @@
 """Pre-snapshot gate: the round may not end on a red suite (VERDICT r3 #3).
 
 Runs the full pytest suite plus the single-chip compile check and exits
-non-zero on ANY failure, printing the failing node ids. Run it before every
-end-of-round snapshot commit:
+non-zero on ANY failure, printing the failing node ids. Also inspects the
+newest BENCH_r*.json artifact: a DeepFM end-to-end/device-path ratio below
+0.9 means the async feed/dispatch pipeline regressed (the end-to-end path is
+leaving device throughput on the table) and fails the gate. Run it before
+every end-of-round snapshot commit:
 
-    python tools/gate.py          # full gate (suite + graft entry)
-    python tools/gate.py --fast   # suite only
+    python tools/gate.py                   # full gate (suite + entry + bench)
+    python tools/gate.py --fast            # suite only
+    python tools/gate.py --bench FILE.json # check one bench artifact only
 """
 from __future__ import annotations
 
+import glob
+import json
 import os
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# below this, train_from_dataset is losing >10% of the measured device-path
+# throughput to the host pipeline — the regression the prefetch/async-window
+# subsystem exists to prevent (ISSUE 2 acceptance line)
+DEEPFM_RATIO_FLOOR = 0.9
 
 
 def run_suite() -> int:
@@ -36,10 +47,76 @@ def run_entry() -> int:
     return r.returncode
 
 
+def _bench_metrics(text: str) -> dict | None:
+    """Extract bench.py's metrics dict from an artifact: either the raw JSON
+    line bench.py prints, or the driver's wrapper object (whose "parsed"
+    field — or the stdout "tail" — carries that line)."""
+    try:
+        data = json.loads(text)
+    except ValueError:
+        data = None
+    if isinstance(data, dict):
+        if data.get("metric"):
+            return data
+        if isinstance(data.get("parsed"), dict) and data["parsed"].get("metric"):
+            return data["parsed"]
+        text = data.get("tail", "")
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{") and '"metric"' in ln:
+            try:
+                return json.loads(ln)
+            except ValueError:
+                continue
+    return None
+
+
+def check_bench(path: str | None = None) -> int:
+    """Flag a DeepFM end-to-end/device-path regression in the bench artifact.
+
+    Pre-pipeline artifacts (no deepfm_e2e_device_ratio field) are skipped so
+    the gate stays meaningful across old snapshots."""
+    if path is None:
+        arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        if not arts:
+            return 0
+        path = arts[-1]
+    try:
+        with open(path) as f:
+            text = f.read()
+        data = _bench_metrics(text)
+    except (OSError, ValueError, IndexError) as e:
+        print(f"[gate] WARN: cannot read bench artifact {path}: {e}",
+              flush=True)
+        return 0
+    if data is None:
+        print(f"[gate] WARN: no bench metrics line in {path}", flush=True)
+        return 0
+    ratio = data.get("deepfm_e2e_device_ratio")
+    if ratio is None:
+        return 0  # artifact predates the pipeline ratio
+    e2e = data.get("deepfm_examples_per_sec")
+    dev = data.get("deepfm_device_path_examples_per_sec")
+    print(f"[gate] bench {os.path.basename(path)}: DeepFM e2e/device "
+          f"ratio {ratio} (e2e {e2e} ex/s, device {dev} ex/s)", flush=True)
+    if ratio < DEEPFM_RATIO_FLOOR:
+        print(f"[gate] FAIL: DeepFM end-to-end path delivers only "
+              f"{ratio:.0%} of device-path throughput "
+              f"(floor {DEEPFM_RATIO_FLOOR}) — the feed/dispatch pipeline "
+              f"regressed; judge against deepfm_windows_ex_s spread "
+              f"(PERF.md r5) before blaming code", flush=True)
+        return 1
+    return 0
+
+
 def main() -> int:
+    if "--bench" in sys.argv:
+        arg = sys.argv[sys.argv.index("--bench") + 1:]
+        return check_bench(arg[0] if arg else None)
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
+        rc = rc or check_bench()
     if rc == 0:
         print("[gate] OK — green suite, safe to snapshot")
     return rc
