@@ -8,9 +8,10 @@ Readers mirror the reference's three formats:
   * listwise  — (label_list, feature_list) per query
 
 Real data: Fold1/train.txt & Fold1/vali.txt & Fold1/test.txt under
-DATA_HOME/MQ2007 (the reference's unzipped layout). Zero-egress fallback:
-synthetic queries whose relevance is a noisy linear function of the
-features, so rankers have learnable signal.
+DATA_HOME/MQ2007 (the reference's unzipped layout) — served by `train()`,
+`vali()` and `test()` respectively. Zero-egress fallback: synthetic queries
+whose relevance is a noisy linear function of the features, so rankers have
+learnable signal.
 """
 from __future__ import annotations
 
@@ -18,10 +19,10 @@ import numpy as np
 
 from .common import locate
 
-__all__ = ["train", "test", "Query", "QueryList", "is_synthetic"]
+__all__ = ["train", "vali", "test", "Query", "QueryList", "is_synthetic"]
 
 _N_FEATS = 46
-_SYN_QUERIES = {"train": 120, "test": 30}
+_SYN_QUERIES = {"train": 120, "vali": 30, "test": 30}
 
 
 class Query:
@@ -75,7 +76,7 @@ class QueryList:
 
 
 def _synthetic_queries(tag: str):
-    rng = np.random.default_rng(7 if tag == "train" else 8)
+    rng = np.random.default_rng({"train": 7, "vali": 9, "test": 8}[tag])
     w = np.random.default_rng(99).standard_normal(_N_FEATS)
     for qid in range(_SYN_QUERIES[tag]):
         ql = QueryList()
@@ -105,7 +106,8 @@ def _file_queries(path: str):
 
 
 def _queries(tag: str):
-    fname = {"train": "Fold1/train.txt", "test": "Fold1/test.txt"}[tag]
+    fname = {"train": "Fold1/train.txt", "vali": "Fold1/vali.txt",
+             "test": "Fold1/test.txt"}[tag]
     path = locate("MQ2007", fname)
     return _file_queries(path) if path else _synthetic_queries(tag)
 
@@ -145,6 +147,11 @@ def _reader(tag: str, format: str):
 
 def train(format="pairwise"):
     return _reader("train", format)
+
+
+def vali(format="pairwise"):
+    """The Fold1/vali.txt validation split (reference LETOR layout)."""
+    return _reader("vali", format)
 
 
 def test(format="pairwise"):

@@ -59,14 +59,17 @@ class Communicator:
         self._grads_sent = 0
         self._lock = threading.Lock()
         self._send_errors: dict[str, Exception] = {}
-        # merged-batch retry: short and bounded — the PSClient already
-        # retries each wire RPC with backoff, so this layer only papers over
-        # failures that poison a whole merge (e.g. one endpoint of a sliced
-        # send); anything longer would stall every queue behind it
+        # merged-batch retry: few attempts, fast backoff — the PSClient
+        # already retries each wire RPC with backoff, so this layer only
+        # papers over failures that poison a whole merge (e.g. one endpoint
+        # of a sliced send). The wall-clock budget is FLAGS_rpc_deadline
+        # (reference semantics), not a constant of this file.
         from ..resilience.retry import RetryPolicy
+        from .ps_rpc import rpc_deadline_s
 
         self._send_retry = RetryPolicy(max_attempts=2, base_delay=0.02,
-                                       max_delay=0.1, deadline=5.0)
+                                       max_delay=0.1,
+                                       deadline=rpc_deadline_s())
 
     # -- lifecycle -----------------------------------------------------------
     @classmethod
@@ -97,8 +100,13 @@ class Communicator:
         for q in self._queues.values():
             q.join()  # all enqueued grads merged + sent
         self._running = False
+        from .ps_rpc import rpc_deadline_s
+
+        # backstop only — after the flush the loops exit within one poll
+        # tick; a thread still stuck here is wedged in an RPC, whose own
+        # waits are already bounded by the same deadline
         for t in self._threads:
-            t.join(timeout=5.0)
+            t.join(timeout=rpc_deadline_s())
         self._threads.clear()
         if Communicator._singleton is self:
             Communicator._singleton = None

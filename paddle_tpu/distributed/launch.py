@@ -94,6 +94,20 @@ def launch_ps(args) -> int:
                 f"--servers lists {len(server_eps)} endpoints but "
                 f"--server_num={args.server_num}; drop one or make them "
                 "agree (one local pserver process is spawned per endpoint)")
+        loopback = {"127.0.0.1", "localhost", "::1"}
+        remote = [ep for ep in server_eps
+                  if ep.rsplit(":", 1)[0] not in loopback]
+        if remote and not os.environ.get("PADDLE_PS_AUTHKEY"):
+            # the per-launch generated secret only reaches THIS node's
+            # children; processes launched on the other nodes would hold a
+            # different key and every cross-node connect would die with an
+            # opaque multiprocessing AuthenticationError
+            raise RuntimeError(
+                f"--servers includes non-local endpoint(s) {remote} but "
+                "PADDLE_PS_AUTHKEY is not set. Cross-node pserver RPC "
+                "authenticates with one shared secret: export the same "
+                "PADDLE_PS_AUTHKEY (e.g. `export PADDLE_PS_AUTHKEY=$(openssl "
+                "rand -hex 16)`) on every node before launching")
     else:
         server_eps = [f"{args.node_ip}:{_free_port()}"
                       for _ in range(n_servers)]

@@ -19,14 +19,56 @@ per variable; when every trainer has posted its send_barrier, gradients are
 averaged, the per-block optimize programs run once, the global step++, and
 only then are the barrier replies released — so a subsequent get_var always
 observes the post-update parameters (the reference's send_barrier/
-fetch_barrier protocol collapsed into one blocking round)."""
+fetch_barrier protocol collapsed into one blocking round).
+
+Liveness (the distributed hang defense):
+  * every RPC reply wait and the connect loop are bounded by
+    `FLAGS_rpc_deadline` (ms, reference semantics) — no hardcoded timeouts;
+  * each trainer runs a heartbeat daemon thread (`_HeartbeatSender`, its own
+    connections so a blocking barrier can't delay a beat) that refreshes the
+    server's per-trainer `last_seen` clock;
+  * a server-side monitor thread watches stalled sync rounds: a trainer that
+    is holding the barrier hostage with no liveness signal for the deadline
+    is EVICTED — its half-round gradients are dropped, the barrier count
+    renormalizes to the survivors, the round runs, and the eviction is
+    logged (`PServerRuntime.liveness_log`) — instead of blocking everyone;
+  * an evicted trainer that comes back (an explicit `rejoin` RPC from a
+    restarted process resuming at CheckpointManager.latest_step, or simply
+    its next send/barrier if it was a false positive) is re-admitted at the
+    next barrier accounting, and the server grants evicted trainers a
+    rejoin-grace window before it will shut down without them."""
 from __future__ import annotations
 
 import threading
+import time
 from multiprocessing.connection import Client, Listener
 from typing import Any
 
 import numpy as np
+
+
+def rpc_deadline_s() -> float:
+    """`FLAGS_rpc_deadline` (milliseconds, reference
+    fluid/__init__.py:65-71 semantics) as seconds; floor 1ms."""
+    from .. import flags
+
+    try:
+        ms = float(flags.get_flag("rpc_deadline"))
+    except KeyError:  # flags module mid-import
+        ms = 180000.0
+    return max(ms, 1.0) / 1000.0
+
+
+def heartbeat_timeout_s() -> float:
+    """Server-side liveness deadline: `FLAGS_heartbeat_timeout_ms`, falling
+    back to the RPC deadline when unset (0)."""
+    from .. import flags
+
+    try:
+        ms = float(flags.get_flag("heartbeat_timeout_ms"))
+    except KeyError:
+        ms = 0.0
+    return ms / 1000.0 if ms > 0 else rpc_deadline_s()
 
 def _authkey() -> bytes:
     """Connection auth secret. The launcher exports PADDLE_PS_AUTHKEY (one
@@ -140,6 +182,69 @@ def send_sparse_sections(client, name: str, sr, epmap, begins,
                         SelectedRows(rows[mask] - b, vals[mask], s))
 
 
+class _HeartbeatSender(threading.Thread):
+    """Per-client liveness beacon: a daemon thread sending `hb` frames to
+    every pserver at FLAGS_heartbeat_interval_ms over its OWN connections —
+    a blocking sync-barrier RPC holds the shared connection's lock for the
+    whole round, so beats must never ride that socket. A beat's reply
+    carries the server's eviction verdict for this trainer (surfaced via
+    PSClient.was_evicted so a partitioned-but-alive trainer can notice and
+    rejoin)."""
+
+    def __init__(self, client: "PSClient", interval_s: float):
+        super().__init__(daemon=True,
+                         name=f"ps-heartbeat-{client.trainer_id}")
+        self.client = client
+        self.interval = float(interval_s)
+        self.stop_event = threading.Event()
+        self.evicted = threading.Event()
+        self._conns: dict[str, Any] = {}
+
+    def run(self):
+        from ..resilience.faults import InjectedFault, fault_point
+
+        while not self.stop_event.wait(self.interval):
+            try:
+                fault_point("heartbeat_loss")
+            except InjectedFault:
+                continue  # this beat is lost on the (simulated) floor
+            for ep in self.client.endpoints:
+                if self.stop_event.is_set():
+                    return
+                self._beat(ep)
+
+    def _beat(self, ep: str):
+        try:
+            conn = self._conns.get(ep)
+            if conn is None:
+                conn = self._conns[ep] = Client(_parse_ep(ep),
+                                                authkey=_authkey())
+            conn.send_bytes(_pack({"op": "hb",
+                                   "trainer": self.client.trainer_id}))
+            if not conn.poll(max(self.interval, 1.0)):
+                raise TimeoutError("heartbeat reply timed out")
+            meta, _ = _unpack(conn.recv_bytes())
+            if meta.get("evicted"):
+                self.evicted.set()
+        except Exception:
+            # a sick endpoint only costs its own beat; redial next tick
+            conn = self._conns.pop(ep, None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def stop(self):
+        self.stop_event.set()
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._conns.clear()
+
+
 class PSClient:
     """One connection per pserver endpoint; thread-safe via a lock per conn."""
 
@@ -156,6 +261,7 @@ class PSClient:
         # Connection under different locks
         self._create_lock = threading.Lock()
         self._retry = None  # lazy RetryPolicy (resilience/retry.py)
+        self._hb: _HeartbeatSender | None = None
 
     def _policy(self):
         if self._retry is None:
@@ -185,16 +291,15 @@ class PSClient:
         return inst
 
     def _conn(self, ep: str):
-        import time
-
         # the global lock only guards per-endpoint lock creation; the
-        # (possibly 30s) connect-retry runs under the ENDPOINT's lock so one
-        # unreachable server cannot stall RPCs to healthy ones
+        # (FLAGS_rpc_deadline-bounded) connect-retry runs under the
+        # ENDPOINT's lock so one unreachable server cannot stall RPCs to
+        # healthy ones
         with self._create_lock:
             lock = self._locks.setdefault(ep, threading.Lock())
         with lock:
             if ep not in self._conns:
-                deadline = time.monotonic() + 30.0
+                deadline = time.monotonic() + rpc_deadline_s()
                 while True:
                     try:
                         self._conns[ep] = Client(_parse_ep(ep),
@@ -206,11 +311,32 @@ class PSClient:
                         time.sleep(0.2)  # server may still be starting
         return self._conns[ep], lock
 
-    def _call(self, ep: str, meta: dict, tensors=()):
-        """One framed request/reply round; returns (meta, tensors)."""
+    def _call(self, ep: str, meta: dict, tensors=(), timeout=None):
+        """One framed request/reply round; returns (meta, tensors).
+
+        The reply wait is bounded: `timeout` seconds when given, else
+        FLAGS_rpc_deadline — a dead server raises TimeoutError (transient,
+        so the retrying callers redial) instead of blocking forever."""
+        from ..resilience.faults import fault_point
+
+        fault_point("rpc_drop")
+        if timeout is None:
+            timeout = rpc_deadline_s()
         conn, lock = self._conn(ep)
         with lock:
             conn.send_bytes(_pack(meta, tensors))
+            if timeout and timeout > 0 and not conn.poll(timeout):
+                # a late reply would desync the next RPC's framing — forget
+                # the conn (inline: we already hold this endpoint's lock,
+                # _drop_conn would deadlock re-acquiring it)
+                self._conns.pop(ep, None)
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                raise TimeoutError(
+                    f"pserver {ep}: no reply to '{meta.get('op')}' within "
+                    f"{timeout:.3g}s (FLAGS_rpc_deadline)")
             buf = conn.recv_bytes()
         rmeta, rtensors = _unpack(buf)
         if rmeta.get("s") == "err":
@@ -265,10 +391,68 @@ class PSClient:
         return self._policy().call(
             _do, on_retry=lambda a, e: self._drop_conn(ep))
 
-    def send_barrier(self) -> None:
-        """Blocks until the server has aggregated + applied this round."""
+    # -- liveness ------------------------------------------------------------
+    def start_heartbeat(self) -> None:
+        """Start the liveness beacon (idempotent; auto-invoked by the first
+        send_barrier so every sync trainer heartbeats without API changes).
+        FLAGS_heartbeat_interval_ms <= 0 disables."""
+        if self._hb is not None and self._hb.is_alive():
+            return
+        from .. import flags
+
+        interval_ms = int(flags.get_flag("heartbeat_interval_ms"))
+        if interval_ms <= 0:
+            return
+        self._hb = _HeartbeatSender(self, interval_ms / 1000.0)
+        self._hb.start()
+
+    def stop_heartbeat(self) -> None:
+        if self._hb is not None:
+            self._hb.stop()
+            self._hb = None
+
+    @property
+    def was_evicted(self) -> bool:
+        """True once any heartbeat reply reported this trainer evicted."""
+        return self._hb is not None and self._hb.evicted.is_set()
+
+    def rejoin(self) -> int:
+        """Ask every pserver to re-admit this trainer after an eviction (a
+        restarted process calls this before resuming from its latest
+        checkpoint). Returns the servers' max global step so the caller can
+        log how far the survivors got while it was away."""
+        step = 0
         for ep in self.endpoints:
-            self._call(ep, {"op": "barrier", "trainer": self.trainer_id})
+            meta, _ = self._call(ep, {"op": "rejoin",
+                                      "trainer": self.trainer_id})
+            step = max(step, int(meta.get("step", 0)))
+        if self._hb is not None:
+            self._hb.evicted.clear()
+        self.start_heartbeat()
+        return step
+
+    def send_barrier(self) -> None:
+        """Blocks until the server has aggregated + applied this round.
+
+        Bounded by 2x FLAGS_rpc_deadline, not 1x: the reply is legitimately
+        gated on the server's own eviction deadline when a peer trainer
+        died, so the client grants one extra deadline of grace before it
+        gives up on the server itself."""
+        import os
+
+        from ..resilience.faults import InjectedFault, fault_point
+
+        try:
+            fault_point("trainer_crash")
+        except InjectedFault:
+            # the in-process stand-in for a mid-round SIGKILL: no cleanup,
+            # no complete, heartbeats die with the process
+            os._exit(137)
+        self.start_heartbeat()
+        timeout = 2.0 * rpc_deadline_s()
+        for ep in self.endpoints:
+            self._call(ep, {"op": "barrier", "trainer": self.trainer_id},
+                       timeout=timeout)
 
     def fetch_barrier(self) -> None:
         pass  # subsumed: send_barrier only returns post-update
@@ -282,13 +466,15 @@ class PSClient:
                             "trainer": self.trainer_id})
 
     def send_complete(self) -> None:
+        self.stop_heartbeat()
         for ep in self.endpoints:
             try:
                 self._call(ep, {"op": "complete", "trainer": self.trainer_id})
-            except (EOFError, ConnectionError, RuntimeError):
+            except (EOFError, ConnectionError, TimeoutError, RuntimeError):
                 pass
 
     def close(self):
+        self.stop_heartbeat()
         for conn in self._conns.values():
             try:
                 conn.close()
@@ -341,12 +527,121 @@ class PServerRuntime:
         self._completed: set[int] = set()
         self._step = 0
         self._shutdown = threading.Event()
+        # -- liveness state (monitor thread + heartbeat handlers) -----------
+        # invariant: _evicted and _completed stay disjoint
+        self._last_seen: dict[int, float] = {}
+        self._evicted: set[int] = set()
+        self._round_started: float | None = None
+        self._all_done_since: float | None = None
+        self.liveness_log: list[dict] = []  # evict/rejoin forensic record
+
+    # -- liveness ------------------------------------------------------------
+    def _touch_locked(self, trainer) -> None:
+        if trainer is not None:
+            self._last_seen[int(trainer)] = time.monotonic()
+
+    def _readmit_locked(self, trainer, how: str) -> None:
+        """Re-admit an evicted trainer. Explicit `rejoin` RPCs land here, but
+        so does an evicted trainer's next send/barrier — a false-positive
+        eviction (e.g. a long GC pause) self-heals on its next round. Net
+        barrier accounting stays consistent mid-round: readmission raises
+        the active count by one exactly when the trainer re-enters the
+        protocol."""
+        t = int(trainer)
+        if t not in self._evicted:
+            return
+        self._evicted.discard(t)
+        self._all_done_since = None
+        self.liveness_log.append({"event": "rejoin", "trainer": t,
+                                  "step": self._step, "via": how})
+        print(f"[ps_rpc] {self.endpoint}: trainer {t} rejoined via {how} "
+              f"at step {self._step}", flush=True)
+
+    def _evict_locked(self, t: int, idle_s: float, timeout_s: float) -> None:
+        self._evicted.add(t)
+        # the dead trainer's half-round gradients must not leak into the
+        # survivors' average (_run_round rescales to the active count)
+        for buf in self._grad_buf.values():
+            buf.pop(t, None)
+        self.liveness_log.append({"event": "evict", "trainer": t,
+                                  "step": self._step,
+                                  "idle_s": round(idle_s, 3)})
+        print(f"[ps_rpc] {self.endpoint}: evicted trainer {t} from the "
+              f"sync barrier at step {self._step} (no liveness signal for "
+              f"{idle_s:.2f}s > {timeout_s:.2f}s deadline)", flush=True)
+
+    def _maybe_release_barrier_locked(self) -> bool:
+        """Run the round and release every waiting trainer once the posted
+        barriers cover all ACTIVE (not completed, not evicted) trainers."""
+        if (not self._barriers_seen
+                or len(self._barriers_seen) < self._active_trainers()):
+            return False
+        self._run_round()
+        waiting, self._barrier_waiting = self._barrier_waiting, []
+        self._barriers_seen = set()
+        self._round_started = None
+        for c in waiting:
+            try:
+                _reply_ok(c)
+            except Exception:
+                pass
+        return True
+
+    def _monitor_loop(self):
+        """Liveness monitor: while a sync round is blocked, evict trainers
+        whose last heartbeat/RPC (or, if never seen, the round's start) is
+        older than the liveness deadline, then re-check barrier release.
+        Also enforces the rejoin-grace shutdown so a permanently-dead
+        trainer cannot make the server serve forever after everyone else
+        completed."""
+        while not self._shutdown.is_set():
+            timeout = heartbeat_timeout_s()
+            self._shutdown.wait(min(max(timeout / 4.0, 0.05), 1.0))
+            if self._shutdown.is_set():
+                return
+            now = time.monotonic()
+            shutdown = False
+            with self._lock:
+                if self._barrier_waiting and self._round_started is not None:
+                    for t in range(self.n_trainers):
+                        if (t in self._barriers_seen or t in self._completed
+                                or t in self._evicted):
+                            continue
+                        # clamp to round start: eviction measures the stall,
+                        # and a trainer that last spoke long before this
+                        # round still gets one full deadline of it
+                        seen = max(self._last_seen.get(t, 0.0),
+                                   self._round_started)
+                        idle = now - seen
+                        if idle > timeout:
+                            self._evict_locked(t, idle, timeout)
+                    self._maybe_release_barrier_locked()
+                remaining = (self.n_trainers - len(self._completed)
+                             - len(self._evicted))
+                if self._evicted and remaining <= 0 and self._completed:
+                    if self._all_done_since is None:
+                        self._all_done_since = now
+                    elif now - self._all_done_since > max(10.0 * timeout,
+                                                          60.0):
+                        print(f"[ps_rpc] {self.endpoint}: evicted "
+                              f"trainer(s) {sorted(self._evicted)} never "
+                              f"rejoined within the grace window — "
+                              f"shutting down", flush=True)
+                        shutdown = True
+                else:
+                    self._all_done_since = None
+            if shutdown:
+                self._signal_shutdown()
+                return
 
     # -- request handlers ----------------------------------------------------
     def _handle_send(self, msg):
         name = msg["name"]
         kind = msg["value"][0]
         with self._lock:
+            self._touch_locked(msg.get("trainer"))
+            if msg.get("trainer") is not None:
+                self._readmit_locked(msg["trainer"], how="send")
             buf = self._grad_buf.setdefault(name, {})
             if kind == "sparse" and msg["trainer"] in buf:
                 # accumulate repeated sparse sends within a round
@@ -370,23 +665,19 @@ class PServerRuntime:
 
     def _handle_barrier(self, msg, conn):
         with self._lock:
-            self._barriers_seen.add(msg["trainer"])
+            t = msg["trainer"]
+            self._touch_locked(t)
+            self._readmit_locked(t, how="barrier")
+            if not self._barrier_waiting:
+                self._round_started = time.monotonic()  # the stall clock
+            self._barriers_seen.add(t)
             self._barrier_waiting.append(conn)
-            ready = len(self._barriers_seen) >= self._active_trainers()
-            if ready:
-                self._run_round()
-                waiting, self._barrier_waiting = self._barrier_waiting, []
-                self._barriers_seen = set()
-                for c in waiting:
-                    try:
-                        _reply_ok(c)
-                    except Exception:
-                        pass
+            if self._maybe_release_barrier_locked():
                 return None  # replies already sent
         return "wait"  # reply deferred until the round completes
 
     def _active_trainers(self):
-        return self.n_trainers - len(self._completed)
+        return self.n_trainers - len(self._completed) - len(self._evicted)
 
     def _run_round(self):
         # scale by the ACTIVE trainer count, not by how many posted this
@@ -471,6 +762,7 @@ class PServerRuntime:
 
     def _handle_get(self, msg):
         with self._lock:
+            self._touch_locked(msg.get("trainer"))
             v = self.scope.find_var(msg["name"])
             if v is None:
                 raise KeyError(f"pserver has no var '{msg['name']}'")
@@ -566,6 +858,8 @@ class PServerRuntime:
                 "launcher does this automatically)")
         self._warm_optimize_programs()
         listener = Listener(_parse_ep(self.endpoint), authkey=_authkey())
+        threading.Thread(target=self._monitor_loop, daemon=True,
+                         name="ps-liveness-monitor").start()
         threads = []
         while not self._shutdown.is_set():
             try:
@@ -618,22 +912,28 @@ class PServerRuntime:
                         pass  # reply comes when the round completes
                 elif op == "checkpoint":
                     _reply_ok(conn, path=self._handle_checkpoint(msg))
+                elif op == "hb":
+                    with self._lock:
+                        self._touch_locked(msg["trainer"])
+                        evicted = int(msg["trainer"]) in self._evicted
+                    _reply_ok(conn, evicted=evicted)
+                elif op == "rejoin":
+                    with self._lock:
+                        self._touch_locked(msg["trainer"])
+                        # a restarted trainer trains again: it owes a fresh
+                        # `complete`, so it cannot stay in the done set
+                        self._completed.discard(int(msg["trainer"]))
+                        self._readmit_locked(msg["trainer"], how="rejoin")
+                        step = self._step
+                    _reply_ok(conn, step=step)
                 elif op == "complete":
                     with self._lock:
+                        self._touch_locked(msg["trainer"])
                         self._completed.add(msg["trainer"])
+                        self._evicted.discard(int(msg["trainer"]))
                         done = len(self._completed) >= self.n_trainers
                         # release any trainers stuck on the barrier
-                        if self._barriers_seen and (
-                                len(self._barriers_seen)
-                                >= self._active_trainers()):
-                            self._run_round()
-                            for c in self._barrier_waiting:
-                                try:
-                                    _reply_ok(c)
-                                except Exception:
-                                    pass
-                            self._barrier_waiting = []
-                            self._barriers_seen = set()
+                        self._maybe_release_barrier_locked()
                     _reply_ok(conn)
                     if done:
                         self._signal_shutdown()

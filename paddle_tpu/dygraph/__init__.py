@@ -676,7 +676,16 @@ class NCE(Layer):
 class PRelu(Layer):
     """reference dygraph/nn.py PRelu. mode: all | channel | element;
     channel_or_shape: channel count for 'channel', full feature shape for
-    'element' (ignored for 'all')."""
+    'element' (ignored for 'all').
+
+    Deliberate layout divergence from the reference in 'channel' mode: the
+    alpha parameter is stored as [C] here, where the reference stores
+    [1, C, 1, 1]. The prelu op broadcasts alpha over the channel axis
+    either way, so numerics are identical, but the saved shapes differ —
+    reference-trained PRelu checkpoints cannot be loaded into this layer
+    directly (reshape the reference's [1, C, 1, 1] alpha to [C] — or [C]
+    to [1, C, 1, 1] going the other way — when converting). Matches the
+    layers.nn lstm flat-weight note."""
 
     def __init__(self, mode="all", channel_or_shape=None, dtype="float32"):
         super().__init__()

@@ -432,9 +432,11 @@ class Executor:
         self.place = place
         # program -> {signature: _Compiled}
         self._cache: "weakref.WeakKeyDictionary[Program, dict]" = weakref.WeakKeyDictionary()
-        # completion tokens of dispatched-but-undrained async steps
-        # (run_async window, bounded by FLAGS_max_inflight_steps)
+        # (step id, completion token) of dispatched-but-undrained async
+        # steps (run_async window, bounded by FLAGS_max_inflight_steps);
+        # the ids feed the hang watchdog's state dump
         self._inflight: collections.deque = collections.deque()
+        self._dispatch_seq = 0
 
     # -- public API ---------------------------------------------------------
     def run(
@@ -475,19 +477,56 @@ class Executor:
         outs, token = self._run_impl(program, feed, fetch_list, scope,
                                      False, rng_counter)
         if token is not None:
-            self._inflight.append(token)
+            self._dispatch_seq += 1
+            self._inflight.append((self._dispatch_seq, token))
             window = int(flags.get_flag("max_inflight_steps"))
             if window > 0:
                 while len(self._inflight) > window:
                     with profiler.stage_timer("pipeline.window_drain"):
-                        jax.block_until_ready(self._inflight.popleft())
+                        self._drain_oldest()
         return outs
 
     def wait(self):
         """Block until every run_async step dispatched so far has completed
-        on the device (epoch boundary / before reading trained state)."""
+        on the device (epoch boundary / before reading trained state).
+        Bounded by the hang watchdog: a wedged step raises StallError with
+        an in-flight state dump instead of blocking forever."""
         while self._inflight:
-            jax.block_until_ready(self._inflight.popleft())
+            self._drain_oldest()
+
+    def _drain_oldest(self):
+        """Wait for the OLDEST dispatched step's completion token under the
+        resilience watchdog (FLAGS_watchdog_stall_s): no device progress
+        within the window raises StallError carrying the step ids still in
+        flight, the window depth, and the per-stage profiler counters. The
+        `pipeline_stall` fault site simulates the wedge so the path is
+        testable on a healthy host; on StallError the queue is left intact
+        for forensics."""
+        from .resilience.faults import InjectedFault, fault_point
+        from .resilience.watchdog import Watchdog, runtime_state
+
+        step_id, token = self._inflight[0]
+        stalled = False
+        try:
+            fault_point("pipeline_stall")
+        except InjectedFault:
+            stalled = True  # behave as if the device never completes
+        wd = Watchdog()
+        is_ready = getattr(token, "is_ready", None)
+        if not stalled and (not wd.enabled or is_ready is None):
+            jax.block_until_ready(token)
+        else:
+            def state():
+                return runtime_state(
+                    oldest_step=step_id,
+                    inflight_step_ids=[s for s, _ in self._inflight],
+                    inflight_depth=len(self._inflight),
+                    max_inflight_steps=int(
+                        flags.get_flag("max_inflight_steps")))
+
+            wd.wait((lambda: False) if stalled else is_ready, state,
+                    what=f"Executor async step {step_id}")
+        self._inflight.popleft()
 
     def _run_impl(
         self,

@@ -101,6 +101,26 @@ _define("feed_bucketing", False,
         "the (program, feed-signature) compile cache is hit instead of "
         "recompiling the last batch of every epoch; loss/metric ops must "
         "honor the mask for exact numerics (see README)")
+# distributed liveness knobs (distributed/ps_rpc.py, resilience/watchdog.py)
+_define("rpc_deadline", 180000,
+        "pserver RPC deadline in MILLISECONDS (reference FLAGS_rpc_deadline, "
+        "python/paddle/fluid/__init__.py:65-71): bounds pserver connects, "
+        "every request/reply round, and — doubled, to leave the server room "
+        "to evict a dead peer first — the sync barrier wait. The server's "
+        "liveness monitor also derives its dead-trainer eviction deadline "
+        "from this when FLAGS_heartbeat_timeout_ms is 0")
+_define("heartbeat_interval_ms", 500,
+        "trainer->pserver heartbeat cadence (PSClient daemon thread, "
+        "auto-started at the first sync barrier); <=0 disables heartbeats")
+_define("heartbeat_timeout_ms", 0,
+        "server-side liveness deadline: a trainer holding up a sync round "
+        "whose last heartbeat (or RPC) is older than this is EVICTED from "
+        "the barrier; 0 = derive from FLAGS_rpc_deadline")
+_define("watchdog_stall_s", 600.0,
+        "hang watchdog window for Executor.run_async/wait completion-token "
+        "drains and DeviceLoader batch waits: if no progress within this "
+        "many seconds a StallError carrying the in-flight state dump is "
+        "raised instead of blocking forever; <=0 disables the watchdog")
 # resilience runtime knobs (resilience/: faults, retry, checkpoint, runner)
 _define("fault_plan", "",
         "deterministic fault-injection plan for the named runtime sites "

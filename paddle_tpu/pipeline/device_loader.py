@@ -71,8 +71,19 @@ class DeviceLoader:
         source, place = self._source, self._place
 
         def staged():
+            import threading
+
+            from ..resilience.faults import InjectedFault, fault_point
+
             it = iter(source())
             while True:
+                try:
+                    fault_point("pipeline_stall")
+                except InjectedFault:
+                    # simulated wedge: the producer parks forever (hung I/O
+                    # stand-in) so the consumer-side stall watchdog must
+                    # fire; the parked daemon thread dies with the process
+                    threading.Event().wait()
                 t0 = time.perf_counter()
                 try:
                     feed = next(it)
@@ -82,7 +93,11 @@ class DeviceLoader:
                                       time.perf_counter() - t0)
                 yield place(feed)
 
-        return _prefetch_iter(staged, self.depth)
+        from ..resilience.watchdog import stall_window_s
+
+        return _prefetch_iter(staged, self.depth,
+                              stall_window=stall_window_s() or None,
+                              stall_what="DeviceLoader batch wait")
 
     # reader-creator calling convention (paddle readers are zero-arg callables)
     __call__ = __iter__

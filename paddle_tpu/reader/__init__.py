@@ -103,10 +103,17 @@ class _End:
 _END = _End()
 
 
-def _prefetch_iter(source_gen_fn, size):
+def _prefetch_iter(source_gen_fn, size, stall_window=None,
+                   stall_what="prefetch consumer"):
     """Shared bounded-queue prefetch: propagates producer exceptions to the
     consumer and unblocks/stops the producer if the consumer abandons the
-    iteration (no leaked threads stuck on q.put)."""
+    iteration (no leaked threads stuck on q.put).
+
+    stall_window (seconds, optional): bound the consumer's wait for the
+    next staged batch — a producer that wedges without raising (hung I/O,
+    a deadlocked transform) raises `resilience.StallError` with a queue
+    state dump after the window instead of hanging the training loop
+    forever (DeviceLoader passes FLAGS_watchdog_stall_s here)."""
     q: queue.Queue = queue.Queue(maxsize=size)
     err: list = []
     stop = threading.Event()
@@ -134,9 +141,29 @@ def _prefetch_iter(source_gen_fn, size):
 
     t = threading.Thread(target=fill, daemon=True)
     t.start()
+
+    def _get_bounded():
+        import time
+
+        deadline = time.monotonic() + stall_window
+        while True:
+            try:
+                return q.get(timeout=0.1)
+            except queue.Empty:
+                if time.monotonic() > deadline:
+                    from ..resilience.watchdog import (StallError,
+                                                       runtime_state)
+
+                    raise StallError(
+                        stall_what, stall_window,
+                        runtime_state(queue_depth=q.qsize(),
+                                      queue_capacity=size,
+                                      producer_alive=t.is_alive()))
+
     try:
         while True:
-            e = q.get()
+            e = (_get_bounded() if stall_window and stall_window > 0
+                 else q.get())
             if e is _END:
                 if err:
                     raise err[0]

@@ -18,6 +18,10 @@ compose into a training loop that survives partial failure:
                 periodic save, restore-and-replay on fault, and graceful
                 degradation (cache invalidation, then jax.disable_jit)
                 before surfacing the error.
+  * watchdog  — `Watchdog`/`StallError`: bounded host-side waits for the
+                async executor drain and DeviceLoader; a wedged step dumps
+                in-flight state instead of hanging forever
+                (`FLAGS_watchdog_stall_s`).
 """
 from .faults import (  # noqa: F401
     FAULT_SITES,
@@ -31,10 +35,12 @@ from .faults import (  # noqa: F401
 from .retry import RetryPolicy, io_policy, rpc_policy  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 from .runner import CheckpointedRunner, StepFailure  # noqa: F401
+from .watchdog import StallError, Watchdog, stall_window_s  # noqa: F401
 
 __all__ = [
     "FAULT_SITES", "FaultPlan", "InjectedFault", "fault_point",
     "fault_scope", "fault_stats", "install_plan",
     "RetryPolicy", "io_policy", "rpc_policy",
     "CheckpointManager", "CheckpointedRunner", "StepFailure",
+    "StallError", "Watchdog", "stall_window_s",
 ]

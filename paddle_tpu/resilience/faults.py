@@ -9,6 +9,19 @@ host, reproducibly. This module plants named *sites* in the hot paths —
     ps.recv           PSClient.get_var, before the wire
     collective.step   Executor.run, once per executed step
     executor.compile  Executor._compile, before lowering
+    rpc_drop          PSClient._call, before ANY request frame hits the wire
+                      (send/get/prefetch retry it like a real transport drop;
+                      barrier/checkpoint surface it)
+    trainer_crash     PSClient.send_barrier — the trainer process dies via
+                      os._exit(137) with no cleanup, the in-process stand-in
+                      for a mid-round SIGKILL (only schedule it in a
+                      subprocess worker's plan)
+    heartbeat_loss    the PSClient heartbeat thread's tick — that beat is
+                      silently skipped, so a scheduled run of hits starves
+                      the server's liveness monitor into evicting
+    pipeline_stall    Executor's async completion-token drain and the
+                      DeviceLoader producer — the wait wedges as if the
+                      device/feed hung, so the resilience watchdog must fire
 
 — and a *plan* that decides, per site and per hit, whether to raise an
 `InjectedFault`. Plans are either explicit hit schedules or seeded Bernoulli
@@ -38,6 +51,7 @@ __all__ = ["FAULT_SITES", "InjectedFault", "FaultPlan", "fault_point",
 # a typo'd site name fails loudly instead of silently never firing
 FAULT_SITES = frozenset({
     "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
+    "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
 })
 
 

@@ -1,0 +1,94 @@
+"""Hang watchdogs: bounded waits that dump state instead of blocking forever.
+
+A distributed training step can wedge in ways a retry policy never sees —
+the device executable deadlocks, a feed pipeline's producer thread dies
+holding its queue, a pserver stops mid-round. The symptom is always the
+same: some host-side wait (`Executor.wait` draining a completion token, a
+`DeviceLoader` consumer blocking on the staging queue) simply never
+returns, and the job hangs with zero diagnostics until an external timeout
+kills it.
+
+This module turns those waits into *bounded* waits. `Watchdog.wait`
+polls a readiness predicate; if `FLAGS_watchdog_stall_s` passes with no
+progress it raises `StallError` carrying a state dump (in-flight step ids,
+queue depths, per-stage profiler counters) assembled at the moment of the
+stall — the forensic record the reference stack's `GetMonitorThreadPool`
+style hang reports provide, but as a structured exception the caller (or a
+CheckpointedRunner) can act on.
+
+The `pipeline_stall` fault site (resilience/faults.py) simulates a wedge on
+demand so the watchdog path is testable on one healthy host.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+__all__ = ["StallError", "Watchdog", "stall_window_s", "runtime_state"]
+
+
+def stall_window_s() -> float:
+    """The configured watchdog window in seconds (<=0 = disabled)."""
+    from .. import flags
+
+    try:
+        return float(flags.get_flag("watchdog_stall_s"))
+    except KeyError:  # flags module mid-import
+        return 0.0
+
+
+class StallError(RuntimeError):
+    """No progress within the watchdog window; `.state` holds the dump."""
+
+    def __init__(self, what: str, window_s: float, state: dict | None = None):
+        self.what = what
+        self.window_s = float(window_s)
+        self.state = dict(state or {})
+        try:
+            dump = json.dumps(self.state, indent=1, default=str, sort_keys=True)
+        except (TypeError, ValueError):
+            dump = repr(self.state)
+        super().__init__(
+            f"{what}: no progress within {window_s:.3g}s "
+            f"(FLAGS_watchdog_stall_s) — in-flight state:\n{dump}")
+
+
+class Watchdog:
+    """Poll-based stall detector for host-side waits.
+
+    `wait(ready, state, what)` returns as soon as `ready()` is truthy and
+    raises `StallError(what, window, state())` once `window_s` elapses.
+    The poll interval self-scales (1ms .. 50ms) so short waits stay cheap
+    and long ones don't spin.
+    """
+
+    def __init__(self, window_s: float | None = None):
+        self.window_s = (stall_window_s() if window_s is None
+                         else float(window_s))
+
+    @property
+    def enabled(self) -> bool:
+        return self.window_s > 0.0
+
+    def wait(self, ready: Callable[[], bool],
+             state: Callable[[], dict] | None = None,
+             what: str = "wait") -> None:
+        deadline = time.monotonic() + self.window_s
+        interval = 0.001
+        while not ready():
+            if time.monotonic() > deadline:
+                raise StallError(what, self.window_s,
+                                 state() if state is not None else {})
+            time.sleep(interval)
+            interval = min(interval * 2, 0.05)
+
+
+def runtime_state(**extra) -> dict:
+    """Common state-dump fields every watchdog site includes: per-stage
+    profiler counters plus whatever the site knows (step ids, depths)."""
+    from .. import profiler
+
+    out = {"profiler_stages": profiler.stage_counters()}
+    out.update(extra)
+    return out

@@ -1,0 +1,110 @@
+"""Liveness-scenario trainer/pserver worker (dist_*.py launcher pattern).
+
+Exercises the distributed liveness layer end to end: trainers checkpoint
+every step with a CheckpointManager; a trainer whose environment carries
+FLAGS_fault_plan="trainer_crash:K" dies via os._exit(137) at its K-th sync
+barrier — the in-process stand-in for a mid-round SIGKILL (no cleanup, no
+`complete`, heartbeats die with it). The pserver's liveness monitor must
+evict it within the FLAGS_rpc_deadline and release the surviving trainers'
+barrier; a fresh invocation on the same checkpoint root rejoins the server
+and resumes from latest_step().
+
+usage: dist_liveness.py ROLE EPS TRAINER_ID N_TRAINERS OUT_NPZ CKPT_ROOT \
+       [CURRENT_EP]
+"""
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+from paddle_tpu.resilience import CheckpointManager  # noqa: E402
+
+STEPS = 5
+FULL_BATCH = 32
+
+
+def build():
+    x = L.data(name="x", shape=[16], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    h = L.fc(x, size=64, act="relu")
+    pred = L.fc(h, size=1)
+    loss = L.mean(L.square_error_cost(pred, y))
+    return loss
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((FULL_BATCH, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 1)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def main():
+    role, eps, trainer_id, n_trainers, out, ckpt_root = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5], sys.argv[6])
+    current_ep = sys.argv[7] if len(sys.argv) > 7 else None
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = 7
+    startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            loss = build()
+            pt.optimizer.SGD(0.1).minimize(loss)
+
+    exe = pt.Executor()
+    t = pt.DistributeTranspiler()
+    t.transpile(trainer_id, program=main_p, pservers=eps,
+                trainers=n_trainers, sync_mode=True, startup_program=startup)
+
+    if role == "pserver":
+        exe.run(t.get_startup_program())
+        exe.run(t.get_pserver_program(current_ep))  # blocks until complete
+        return
+
+    # trainer: checkpoint every step; resume + rejoin if a root exists
+    exe.run(startup)
+    mgr = CheckpointManager(ckpt_root, keep_last_k=3, main_program=main_p)
+    latest = mgr.latest_step()
+    start = 0
+    if latest is not None:
+        mgr.restore(executor=exe, main_program=main_p)
+        start = latest + 1
+        from paddle_tpu.distributed.ps_rpc import PSClient
+
+        client = PSClient.get(tuple(e for e in eps.split(",") if e),
+                              trainer_id)
+        server_step = client.rejoin()
+        print(f"rejoined start={start} server_step={server_step}",
+              flush=True)
+
+    prog = t.get_trainer_program()
+    x, y = full_data()
+    shard = FULL_BATCH // n_trainers
+    lo = trainer_id * shard
+    xs, ys = x[lo:lo + shard], y[lo:lo + shard]
+
+    losses, step_times = [], []
+    for step in range(start, STEPS):
+        t0 = time.monotonic()
+        (lv,) = exe.run(prog, feed={"x": xs, "y": ys},
+                        fetch_list=[loss.name])
+        step_times.append(time.monotonic() - t0)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        mgr.save(step, executor=exe, main_program=main_p)
+    exe.close()
+    np.savez(out, losses=np.asarray(losses),
+             step_times=np.asarray(step_times),
+             start_step=np.asarray(start))
+    print(f"done start={start} max_step_s={max(step_times):.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -278,7 +278,7 @@ def test_distributed_lookup_table_matches_local_dense(tmp_path):
             err_msg=f"dist-lookup param {k} diverged from local dense")
 
 
-def test_wire_frame_roundtrip_and_auth_refusal():
+def test_wire_frame_roundtrip_and_auth_refusal(monkeypatch):
     """The PS wire format is a length-prefixed raw-tensor frame (JSON meta +
     raw blocks), not pickle: roundtrip preserves dtype/shape/values with
     zero-copy views, and a pserver refuses to bind a routable address with
@@ -304,6 +304,7 @@ def test_wire_frame_roundtrip_and_auth_refusal():
     import paddle_tpu as pt
     srv = PServerRuntime("0.0.0.0:29599", n_trainers=1, sync_mode=True,
                          blocks=[], scope=pt.Scope(), executor=pt.Executor())
-    assert "PADDLE_PS_AUTHKEY" not in os.environ
+    # machines that export a real key must still exercise the refusal path
+    monkeypatch.delenv("PADDLE_PS_AUTHKEY", raising=False)
     with pytest.raises(RuntimeError, match="non-loopback"):
         srv.serve()

@@ -1,0 +1,382 @@
+"""Distributed liveness layer: rpc_deadline plumbing, heartbeats,
+dead-trainer eviction/rejoin, and the hang watchdogs.
+
+In-process tests drive PServerRuntime/PSClient directly with shrunken
+deadlines; the chaos-marked scenario SIGKILLs a real subprocess trainer
+mid-sync-round (reference test_dist_base.py:442 kill/retry pattern) and
+asserts the server unblocks within FLAGS_rpc_deadline — not the old fixed
+30 s — and that a restarted trainer rejoins and resumes from its latest
+checkpoint."""
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def liveness_flags():
+    """Shrink every liveness deadline to test scale; restore on exit."""
+    from paddle_tpu import flags
+
+    saved = {k: flags.get_flag(k) for k in
+             ("rpc_deadline", "heartbeat_interval_ms",
+              "heartbeat_timeout_ms", "watchdog_stall_s")}
+    flags.set_flags({"rpc_deadline": 1200, "heartbeat_interval_ms": 100,
+                     "heartbeat_timeout_ms": 0})
+    yield flags
+    flags.set_flags(saved)
+
+
+def _start_server(n_trainers):
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.ps_rpc import PServerRuntime
+
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = PServerRuntime(ep, n_trainers=n_trainers, sync_mode=True,
+                         blocks=[], scope=pt.Scope(), executor=pt.Executor())
+    t = threading.Thread(target=srv.serve, daemon=True)
+    t.start()
+    return ep, srv, t
+
+
+# -- deadline plumbing --------------------------------------------------------
+
+def test_no_hardcoded_deadline_left_in_ps_rpc():
+    """Every timeout in the PS RPC layer must come from FLAGS_rpc_deadline
+    (the old fixed 30.0 s constants are the regression this guards)."""
+    src_path = os.path.join(_REPO, "paddle_tpu", "distributed", "ps_rpc.py")
+    with open(src_path) as f:
+        src = f.read()
+    assert "30.0" not in src
+    assert "rpc_deadline_s()" in src
+
+
+def test_rpc_deadline_flag_registered_with_reference_default():
+    from paddle_tpu import flags
+
+    assert flags.all_flags()["rpc_deadline"] == 180000  # ms, reference
+    assert "heartbeat_interval_ms" in flags.all_flags()
+    assert "heartbeat_timeout_ms" in flags.all_flags()
+    assert "watchdog_stall_s" in flags.all_flags()
+
+
+def test_connect_bounded_by_rpc_deadline(liveness_flags):
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    liveness_flags.set_flags({"rpc_deadline": 400})
+    client = PSClient([f"127.0.0.1:{_free_port()}"], 0)  # nothing listening
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        client.send_barrier()
+    assert time.monotonic() - t0 < 5.0
+    client.close()
+
+
+def test_reply_wait_bounded_by_rpc_deadline(liveness_flags):
+    """A server that accepts but never replies must yield TimeoutError
+    within the (doubled, for barriers) deadline — never an infinite wait."""
+    from multiprocessing.connection import Listener
+
+    from paddle_tpu.distributed.ps_rpc import PSClient, _authkey
+
+    liveness_flags.set_flags({"rpc_deadline": 400})
+    ep = f"127.0.0.1:{_free_port()}"
+    host, port = ep.rsplit(":", 1)
+    listener = Listener((host, int(port)), authkey=_authkey())
+    conns = []
+
+    def mute_server():
+        while True:
+            try:
+                c = listener.accept()
+            except OSError:
+                return
+            conns.append(c)  # read nothing, reply to nothing
+
+    threading.Thread(target=mute_server, daemon=True).start()
+    client = PSClient([ep], 0)
+    client.stop_heartbeat()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="FLAGS_rpc_deadline"):
+        client.send_barrier()
+    assert time.monotonic() - t0 < 5.0
+    client.close()
+    listener.close()
+
+
+# -- heartbeats, eviction, rejoin ---------------------------------------------
+
+def test_dead_trainer_evicted_and_survivor_unblocked(liveness_flags):
+    """Trainer 1 never shows up for the round: the monitor evicts it within
+    the liveness deadline, the survivor's barrier releases, the eviction is
+    logged, and a rejoin re-admits it for the next round."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    ep, srv, _ = _start_server(n_trainers=2)
+    c0 = PSClient([ep], 0)
+    t0 = time.monotonic()
+    c0.send_barrier()  # would block forever without eviction
+    elapsed = time.monotonic() - t0
+    assert elapsed < 4.0, f"survivor blocked {elapsed:.1f}s"
+    evicts = [e for e in srv.liveness_log if e["event"] == "evict"]
+    assert evicts and evicts[0]["trainer"] == 1
+
+    # rejoin: trainer 1 comes back and the next round needs both again
+    c1 = PSClient([ep], 1)
+    c1.rejoin()
+    assert 1 not in srv._evicted
+    assert [e["event"] for e in srv.liveness_log][-1] == "rejoin"
+
+    released = []
+    th = threading.Thread(
+        target=lambda: (c0.send_barrier(), released.append(0)), daemon=True)
+    th.start()
+    time.sleep(0.3)
+    assert not released, "round ran without the rejoined trainer"
+    c1.send_barrier()
+    th.join(5.0)
+    assert released == [0]
+    c0.send_complete()
+    c1.send_complete()
+    c0.close()
+    c1.close()
+
+
+def test_eviction_drops_dead_trainers_half_round_grads(liveness_flags):
+    """Gradients the dead trainer posted before dying must not leak into
+    the survivors' renormalized average."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    ep, srv, _ = _start_server(n_trainers=2)
+    c1 = PSClient([ep], 1)
+    c1.send_var(ep, "w@GRAD", np.ones((2, 2), np.float32))  # then dies
+    c0 = PSClient([ep], 0)
+    c0.send_var(ep, "w@GRAD", np.full((2, 2), 3.0, np.float32))
+    c0.send_barrier()
+    assert 1 in srv._evicted
+    assert 1 not in srv._grad_buf.get("w@GRAD", {})
+    c0.send_complete()
+    c0.close()
+    c1.close()
+
+
+def test_heartbeat_keeps_slow_trainer_admitted(liveness_flags):
+    """The positive case: a trainer that is SLOW but heartbeating must not
+    be evicted even when the round stalls past the deadline — liveness is
+    heartbeats, not round latency."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+
+    liveness_flags.set_flags({"rpc_deadline": 1000})
+    ep, srv, _ = _start_server(n_trainers=2)
+    c0, c1 = PSClient([ep], 0), PSClient([ep], 1)
+    c1.start_heartbeat()  # alive and beating, just slow to compute
+    released = []
+    th = threading.Thread(
+        target=lambda: (c0.send_barrier(), released.append(0)), daemon=True)
+    th.start()
+    # past the 1.0s eviction deadline, but inside the survivor's 2x-deadline
+    # barrier wait — only heartbeats keep this window open
+    time.sleep(1.5)
+    assert not srv._evicted, "heartbeating trainer was evicted"
+    assert not released
+    c1.send_barrier()
+    th.join(5.0)
+    assert released == [0]
+    c0.send_complete()
+    c1.send_complete()
+    c0.close()
+    c1.close()
+
+
+def test_heartbeat_loss_site_starves_monitor_into_eviction(liveness_flags):
+    """The heartbeat_loss fault site: the beacon thread runs but every beat
+    is injected away, so the server's monitor must treat the trainer as
+    dead once the round stalls."""
+    from paddle_tpu.distributed.ps_rpc import PSClient
+    from paddle_tpu.resilience import fault_scope
+
+    ep, srv, _ = _start_server(n_trainers=2)
+    with fault_scope("rand:p=1.0,seed=0,sites=heartbeat_loss"):
+        c1 = PSClient([ep], 1)
+        c1.start_heartbeat()  # every tick hits the fault site
+        c0 = PSClient([ep], 0)
+        t0 = time.monotonic()
+        c0.send_barrier()
+        assert time.monotonic() - t0 < 4.0
+    assert 1 in srv._evicted
+    c1.stop_heartbeat()
+    c0.send_complete()
+    c0.close()
+    c1.close()
+
+
+# -- hang watchdogs -----------------------------------------------------------
+
+def _tiny_train_program():
+    import paddle_tpu as pt
+    from paddle_tpu import layers as L
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            x = L.data(name="x", shape=[4], dtype="float32")
+            loss = L.mean(L.fc(x, size=3))
+            pt.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_watchdog_raises_stall_error_on_injected_pipeline_stall(
+        liveness_flags):
+    """An injected pipeline_stall must turn Executor.wait into a StallError
+    carrying the in-flight state dump — never an indefinite hang."""
+    import paddle_tpu as pt
+    from paddle_tpu.resilience import StallError, fault_scope
+
+    liveness_flags.set_flags({"watchdog_stall_s": 0.3})
+    main, startup, loss = _tiny_train_program()
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with fault_scope("pipeline_stall:1"):
+            exe.run_async(main, feed=feed, fetch_list=[loss.name])
+            with pytest.raises(StallError) as exc:
+                exe.wait()
+        state = exc.value.state
+        assert state["inflight_step_ids"] == [1]
+        assert state["inflight_depth"] == 1
+        assert "profiler_stages" in state
+        assert "FLAGS_watchdog_stall_s" in str(exc.value)
+        exe._inflight.clear()  # forensics done; drop the wedged token
+
+
+def test_watchdog_clean_async_run_unaffected(liveness_flags):
+    """With the watchdog armed but no stall, run_async/wait behave exactly
+    as before (the bounded wait is semantics-free on the happy path)."""
+    import paddle_tpu as pt
+
+    liveness_flags.set_flags({"watchdog_stall_s": 30.0})
+    main, startup, loss = _tiny_train_program()
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        for _ in range(3):
+            (lv,) = exe.run_async(main, feed=feed, fetch_list=[loss.name])
+        exe.wait()
+        assert not exe._inflight
+        assert np.isfinite(float(np.asarray(lv).reshape(-1)[0]))
+
+
+def test_watchdog_device_loader_producer_wedge(liveness_flags):
+    """A wedged feed producer (simulated by pipeline_stall in the
+    DeviceLoader's staging thread) raises StallError with queue state."""
+    from paddle_tpu.pipeline.device_loader import DeviceLoader
+    from paddle_tpu.resilience import StallError, fault_scope
+
+    liveness_flags.set_flags({"watchdog_stall_s": 0.3})
+
+    def src():
+        for _ in range(3):
+            yield {"x": np.ones((2, 4), np.float32)}
+
+    with fault_scope("pipeline_stall:2"):
+        it = iter(DeviceLoader(lambda: src(), depth=1))
+        next(it)  # batch 1 stages fine
+        with pytest.raises(StallError) as exc:
+            next(it)  # producer is parked: the consumer wait must bound
+    assert exc.value.state["queue_depth"] == 0
+    assert "producer_alive" in exc.value.state
+
+
+# -- the SIGKILL-mid-round chaos scenario -------------------------------------
+
+@pytest.mark.chaos
+def test_sigkill_trainer_mid_round_evicted_then_rejoins(tmp_path):
+    """Reference test_dist_base.py:442 kill/retry, liveness edition: one of
+    two sync trainers dies (os._exit(137) via the trainer_crash fault site
+    — a SIGKILL stand-in) at its 3rd barrier. The server must evict it
+    within FLAGS_rpc_deadline (3 s here, NOT the old fixed 30 s) so the
+    survivor finishes all rounds; a restarted trainer must rejoin and
+    resume from its latest checkpoint."""
+    script = os.path.join(_DIR, "dist_liveness.py")
+    ep = f"127.0.0.1:{_free_port()}"
+    deadline_ms = 3000
+
+    def env(extra=None):
+        e = dict(os.environ)
+        e["PYTHONPATH"] = _REPO + os.pathsep + e.get("PYTHONPATH", "")
+        e.pop("FLAGS_fault_plan", None)
+        e["FLAGS_rpc_deadline"] = str(deadline_ms)
+        e["FLAGS_heartbeat_interval_ms"] = "200"
+        e.update(extra or {})
+        return e
+
+    def spawn(args, extra_env=None):
+        return subprocess.Popen(
+            [sys.executable, script, *args], env=env(extra_env),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    ps = spawn(["pserver", ep, "0", "2", str(tmp_path / "ps.npz"),
+                str(tmp_path / "ps_ck"), ep])
+    t0 = spawn(["trainer", ep, "0", "2", str(tmp_path / "t0.npz"),
+                str(tmp_path / "ck0")])
+    # trainer 1 dies at its 3rd sync barrier (step 2), mid-round
+    t1 = spawn(["trainer", ep, "1", "2", str(tmp_path / "t1.npz"),
+                str(tmp_path / "ck1")],
+               extra_env={"FLAGS_fault_plan": "trainer_crash:3"})
+    try:
+        out1, _ = t1.communicate(timeout=240)
+        assert t1.returncode == 137, (t1.returncode, out1.decode()[-2000:])
+
+        # the survivor must complete every round, with the blocked round
+        # bounded by the eviction deadline, not the old fixed 30 s
+        out0, _ = t0.communicate(timeout=240)
+        assert t0.returncode == 0, out0.decode()[-3000:]
+        d0 = np.load(str(tmp_path / "t0.npz"))
+        assert d0["losses"].shape[0] == 5
+        max_step = float(d0["step_times"].max())
+        assert max_step < 20.0, (
+            f"survivor's blocked round took {max_step:.1f}s — eviction did "
+            f"not honor the {deadline_ms}ms deadline")
+        assert max_step >= deadline_ms / 1000.0 * 0.5, (
+            "no round ever blocked — the crash missed the sync round")
+
+        # restart trainer 1 on the same checkpoint root: rejoin + resume
+        t1b = spawn(["trainer", ep, "1", "2", str(tmp_path / "t1.npz"),
+                     str(tmp_path / "ck1")])
+        out1b, _ = t1b.communicate(timeout=240)
+        assert t1b.returncode == 0, out1b.decode()[-3000:]
+        assert b"rejoined start=2" in out1b, out1b.decode()[-2000:]
+        d1 = np.load(str(tmp_path / "t1.npz"))
+        assert int(d1["start_step"]) == 2  # latest ckpt was step 1
+        assert d1["losses"].shape[0] == 3  # steps 2..4 only
+
+        # the pserver observed the full evict -> rejoin lifecycle and shut
+        # down cleanly once both trainers completed
+        outp, _ = ps.communicate(timeout=60)
+        assert ps.returncode == 0, outp.decode()[-3000:]
+        assert b"evicted trainer 1" in outp, outp.decode()[-2000:]
+        assert b"trainer 1 rejoined" in outp, outp.decode()[-2000:]
+    finally:
+        for p in (ps, t0, t1):
+            if p.poll() is None:
+                p.kill()
+        if "t1b" in dir() and t1b.poll() is None:
+            t1b.kill()

@@ -12,10 +12,14 @@ plan string.
 
     python tools/chaos.py --steps 8 --p 0.15 --seed 3
     python tools/chaos.py --plan 'collective.step:2;ckpt.write:1'
+    python tools/chaos.py --stall   # hang-watchdog smoke: an injected
+                                    # pipeline_stall must raise StallError
+                                    # (with a state dump), never hang
 
 Exit code 0 = survived + trajectory matched; 1 = divergence or crash.
-The `chaos` pytest marker (tests/test_chaos.py) runs this same harness
-fast enough for tier-1.
+The `chaos` pytest marker (tests/test_chaos.py, tests/test_liveness.py)
+runs this same harness — plus the SIGKILL-trainer eviction/rejoin
+scenario — fast enough for tier-1.
 """
 from __future__ import annotations
 
@@ -107,6 +111,36 @@ def run_chaos(plan_spec: str, steps: int = 8, seed: int = 0,
             "fired": stats.get("fired", []), "hits": stats.get("hits", {})}
 
 
+def run_stall_smoke(window_s: float = 0.3) -> dict:
+    """Prove the hang watchdog converts a wedged async step into a
+    StallError with a state dump (never an indefinite hang): inject
+    `pipeline_stall` at the first Executor completion-token drain and
+    assert the failure shape. Returns the StallError's state dict."""
+    import paddle_tpu as pt
+    from paddle_tpu import flags
+    from paddle_tpu.resilience import StallError, fault_scope
+
+    main_p, startup, loss = _build(0)
+    old = flags.get_flag("watchdog_stall_s")
+    flags.set_flags({"watchdog_stall_s": window_s})
+    try:
+        with pt.scope_guard(pt.Scope()):
+            exe = pt.Executor()
+            exe.run(startup)
+            with fault_scope("pipeline_stall:1"):
+                exe.run_async(main_p, feed=_feed_fn(0), fetch_list=[loss])
+                try:
+                    exe.wait()
+                except StallError as e:
+                    assert e.state.get("inflight_step_ids"), e.state
+                    assert "profiler_stages" in e.state, e.state
+                    return e.state
+                raise AssertionError(
+                    "injected pipeline_stall did not raise StallError")
+    finally:
+        flags.set_flags({"watchdog_stall_s": old})
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -120,7 +154,20 @@ def main(argv=None) -> int:
                          "plan)")
     ap.add_argument("--root", default=None,
                     help="checkpoint root (default: fresh temp dir)")
+    ap.add_argument("--stall", action="store_true",
+                    help="run the hang-watchdog smoke instead of the "
+                         "fault-plan trajectory check")
     args = ap.parse_args(argv)
+
+    if args.stall:
+        try:
+            state = run_stall_smoke()
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"STALL SMOKE FAILED: {e}", file=sys.stderr)
+            return 1
+        print(f"OK: injected pipeline_stall raised StallError with state "
+              f"dump (in-flight steps {state.get('inflight_step_ids')})")
+        return 0
 
     # ps.send/ps.recv need a live pserver; the single-process smoke covers
     # the executor + checkpoint sites (the dist tests cover the wire)

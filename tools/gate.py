@@ -10,6 +10,9 @@ every end-of-round snapshot commit:
     python tools/gate.py                   # full gate (suite + entry + bench)
     python tools/gate.py --fast            # suite only
     python tools/gate.py --bench FILE.json # check one bench artifact only
+    python tools/gate.py --chaos           # chaos smoke only (`-m chaos`:
+                                           # fault-injection + SIGKILL-
+                                           # trainer liveness subset)
 """
 from __future__ import annotations
 
@@ -34,6 +37,20 @@ def run_suite() -> int:
         cwd=REPO)
     if r.returncode != 0:
         print("[gate] FAIL: test suite is red — do not snapshot", flush=True)
+    return r.returncode
+
+
+def run_chaos() -> int:
+    """The fast chaos subset: every `chaos`-marked test (seeded fault-plan
+    survival + the kill-trainer-mid-round eviction/rejoin scenario)."""
+    print("[gate] running chaos smoke (-m chaos) ...", flush=True)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "chaos",
+         "--tb=line"],
+        cwd=REPO)
+    if r.returncode != 0:
+        print("[gate] FAIL: chaos smoke is red — the resilience/liveness "
+              "runtime regressed", flush=True)
     return r.returncode
 
 
@@ -113,6 +130,8 @@ def main() -> int:
     if "--bench" in sys.argv:
         arg = sys.argv[sys.argv.index("--bench") + 1:]
         return check_bench(arg[0] if arg else None)
+    if "--chaos" in sys.argv:
+        return run_chaos()
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
