@@ -400,10 +400,42 @@ def bench_deepfm(on_tpu: bool):
         device_ex_s = batch / min(dev_windows)
         (lv,) = exe.run(main_p, feed=dev_feed, fetch_list=[avg_loss])
         assert np.isfinite(float(np.asarray(lv)))
+
+    # health-sentinel overhead: the SAME device-path step with the in-graph
+    # numeric guard compiled in (FLAGS_guard_numerics). The sentinel rides
+    # the step's own outputs (a [4] vector + [2] EMA state), so the measured
+    # cost should be noise; tools/gate.py flags > 2% against this baseline
+    from paddle_tpu import flags as pt_flags
+
+    old_guard = pt_flags.get_flag("guard_numerics")
+    pt_flags.set_flags({"guard_numerics": True})
+    try:
+        g_main, g_startup = pt.Program(), pt.Program()
+        with pt.program_guard(g_main, g_startup):
+            with pt.unique_name.guard():
+                g_loss, _, _ = deepfm.deepfm(
+                    n_fields=n_fields, n_dense=n_dense, vocab_size=vocab)
+                pt.optimizer.SGD(learning_rate=1e-3).minimize(g_loss)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(g_startup)
+            g_drain = g_main.all_parameters()[-1].name
+            exe.run(g_main, feed=dev_feed)  # compile
+            np.asarray(pt.global_scope().find_var(g_drain))
+            g_windows = _timed_windows(
+                lambda: exe.run(g_main, feed=dev_feed),
+                lambda: pt.global_scope().find_var(g_drain),
+                50 if on_tpu else 5, 3 if on_tpu else 2)
+        guarded_ex_s = batch / min(g_windows)
+        guard_overhead_pct = max(0.0,
+                                 (1.0 - guarded_ex_s / device_ex_s) * 100.0)
+    finally:
+        pt_flags.set_flags({"guard_numerics": old_guard})
+
     for p in files:
         os.unlink(p)
     os.rmdir(tmp)
-    return n_files * lines_per_file / dt, windows_ex_s, device_ex_s
+    return (n_files * lines_per_file / dt, windows_ex_s, device_ex_s,
+            guard_overhead_pct)
 
 
 def main():
@@ -414,7 +446,7 @@ def main():
     tok_s, bert_mfu, bert_windows = bench_bert(on_tpu, peak)
     img_s, rn_mfu, rn_windows = bench_resnet(on_tpu, peak)
     wmt_tok_s, wmt_mfu, wmt_windows = bench_wmt(on_tpu, peak)
-    ctr_ex_s, ctr_windows, ctr_dev_ex_s = bench_deepfm(on_tpu)
+    ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = bench_deepfm(on_tpu)
     long_ctx = bench_bert_long(on_tpu)
 
     # Per-workload targets. MFU workloads: the 0.45 north star
@@ -462,6 +494,9 @@ def main():
         # pipeline owns this ratio; tools/gate.py flags < 0.9
         "deepfm_device_path_examples_per_sec": round(ctr_dev_ex_s, 2),
         "deepfm_e2e_device_ratio": round(ctr_ex_s / ctr_dev_ex_s, 4),
+        # in-graph health sentinel cost vs the unguarded device path
+        # (resilience/guardrails.py); tools/gate.py flags > 2%
+        "deepfm_guard_overhead_pct": round(ctr_guard_pct, 2),
         # the custom short-seq Pallas attention kernel's proof row: BERT
         # seq-512 tokens/s with the kernel off vs on (on wins ~9%)
         "bert_s512_tokens_per_sec_xla_attn": round(long_ctx["xla"], 2),

@@ -55,7 +55,15 @@ class OptimizerWithMixedPrecision:
                   if needs_scaling else loss)
         params_grads = self._optimizer.backward(
             scaled, startup_program, parameter_list, no_grad_set)
-        return self._unscale_and_check(params_grads, helper, needs_scaling)
+        params_grads = self._unscale_and_check(params_grads, helper,
+                                               needs_scaling)
+        # numeric guardrail composition (resilience/guardrails.py): the
+        # health sentinel must judge the UNSCALED loss (the scaled one moves
+        # with the dynamic scale, poisoning its spike EMA), and AMP's own
+        # @FOUND_INF@ verdict ORs into the health vector so both skip
+        # mechanisms agree — the inner backward recorded the scaled name
+        default_main_program()._guard_loss_name = loss.name
+        return params_grads
 
     def _unscale_and_check(self, params_grads, helper, needs_scaling):
         if not self._use_dynamic:
@@ -77,6 +85,8 @@ class OptimizerWithMixedPrecision:
              "FoundInfinite": [found_inf.name]},
             {},
         )
+        # expose AMP's verdict to the guardrail sentinel (see backward)
+        default_main_program()._guard_found_inf_name = found_inf.name
         good = helper.create_or_get_global_variable(
             "@GOOD_STEPS@", [1], "int32", initializer=Constant(0.0))
         bad = helper.create_or_get_global_variable(

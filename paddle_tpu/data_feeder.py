@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import flags
+from . import flags, profiler
 from .framework import Variable
 
 __all__ = ["DataFeeder", "ROW_MASK_NAME", "pad_feed_to_bucket"]
@@ -76,10 +76,17 @@ class DataFeeder:
 
     def feed(self, iterable) -> dict:
         """iterable: list of samples; each sample is a tuple/list with one
-        entry per feed var. Returns {var_name: batched ndarray}."""
+        entry per feed var. Returns {var_name: batched ndarray}.
+
+        Under FLAGS_feed_skip_corrupt a sample whose ndarray conversion or
+        dtype cast raises (corrupt record) is dropped and counted on the
+        profiler 'feed.skip_corrupt' counter instead of killing the epoch;
+        a batch of ONLY corrupt samples still raises."""
         samples = list(iterable)
         if not samples:
             raise ValueError("DataFeeder.feed got an empty batch")
+        if flags.get_flag("feed_skip_corrupt"):
+            samples = self._drop_corrupt(samples)
         bucketing = self._bucketing()
         out = {}
         for i, var in enumerate(self.feed_vars):
@@ -106,6 +113,26 @@ class DataFeeder:
             bucket = max(self.bucket_size or 0, self._bucket_hwm)
             out = pad_feed_to_bucket(out, bucket, self.mask_name)
         return out
+
+    def _drop_corrupt(self, samples):
+        """Pre-validate each sample field-by-field against its feed var's
+        dtype; the survivors carry already-converted ndarrays so the batch
+        build below never re-hits the corruption."""
+        good, bad = [], 0
+        for s in samples:
+            try:
+                good.append(tuple(
+                    np.asarray(s[i]).astype(v.np_dtype, copy=False)
+                    for i, v in enumerate(self.feed_vars)))
+            except (ValueError, TypeError, IndexError, OverflowError):
+                bad += 1
+        if bad:
+            profiler.bump("feed.skip_corrupt", bad)
+        if not good:
+            raise ValueError(
+                f"DataFeeder.feed: every sample in the batch ({bad}) failed "
+                f"ndarray conversion (FLAGS_feed_skip_corrupt)")
+        return good
 
 
 def _pad_stack(cols, dtype, round_ragged=False):

@@ -27,7 +27,7 @@ import time
 
 import numpy as np
 
-from .. import flags
+from .. import flags, profiler
 
 __all__ = ["Communicator", "GeoCommunicator"]
 
@@ -205,6 +205,8 @@ class Communicator:
 
             rows = np.concatenate([np.asarray(v.rows) for v in sparse])
             vals = np.concatenate([np.asarray(v.values) for v in sparse])
+            if self._drop_nonfinite(name, vals, len(batch)):
+                return
             send_sparse_sections(
                 self.client, name,
                 SelectedRows(rows, vals, sparse[0].height),
@@ -214,7 +216,27 @@ class Communicator:
         for v in batch[1:]:
             acc += np.asarray(v)
         acc /= len(batch)  # mean of merged grads (reference MergeVars)
+        if self._drop_nonfinite(name, acc, len(batch)):
+            return
         send_sections(self.client, name, acc, epmap, sections)
+
+    @staticmethod
+    def _drop_nonfinite(name, arr, n_merged) -> bool:
+        """Fleet numeric hygiene (FLAGS_guard_numerics): one trainer's
+        NaN/Inf gradient must never reach the pservers — on the PS path it
+        would poison EVERY worker's next parameter pull. The poisoned merge
+        is dropped whole (and counted); the sync pserver renormalizes the
+        round to the trainers that did post, exactly as it does for an
+        evicted trainer's half-round (ps_rpc._run_round)."""
+        if not flags.get_flag("guard_numerics"):
+            return False
+        if np.isfinite(arr).all():
+            return False
+        profiler.bump("comm.nonfinite_drop", n_merged)
+        print(f"[communicator] dropping non-finite merged send '{name}' "
+              f"({n_merged} grad(s)) — poisoned gradients never ship",
+              flush=True)
+        return True
 
     # -- recv side -----------------------------------------------------------
     def _recv_loop(self):

@@ -148,7 +148,28 @@ def iter_sections(name: str, arr, epmap, sections):
         yield ep, f"{name}.block{j}", arr[off:off + rows]
 
 
+def _guard_drops_send(name: str, arr) -> bool:
+    """Trainer-side numeric hygiene (FLAGS_guard_numerics, resilience/
+    guardrails.py): a non-finite payload is dropped BEFORE the wire so the
+    pserver never averages poison into shared parameters. The sync server
+    renormalizes the round to the trainers that posted (_run_round), the
+    same stance as PR 3's dead-trainer eviction."""
+    from .. import flags, profiler
+
+    if not flags.get_flag("guard_numerics"):
+        return False
+    a = np.asarray(arr)
+    if a.dtype.kind != "f" or np.isfinite(a).all():
+        return False
+    profiler.bump("ps.nonfinite_drop")
+    print(f"[ps_rpc] dropping non-finite send '{name}' "
+          f"(FLAGS_guard_numerics fleet hygiene)", flush=True)
+    return True
+
+
 def send_sections(client, name: str, arr, epmap, sections) -> None:
+    if _guard_drops_send(name, arr):
+        return
     for ep, wire, part in iter_sections(name, arr, epmap, sections):
         client.send_var(ep, wire, part)
 
@@ -169,6 +190,8 @@ def send_sparse_sections(client, name: str, sr, epmap, begins,
     Empty sections = whole table on epmap[0], global rows as-is."""
     from ..core.selected_rows import SelectedRows
 
+    if _guard_drops_send(name, sr.values):
+        return
     if not sections:
         client.send_var(epmap[0], name, sr)
         return
@@ -680,17 +703,26 @@ class PServerRuntime:
         return self.n_trainers - len(self._completed) - len(self._evicted)
 
     def _run_round(self):
-        # scale by the ACTIVE trainer count, not by how many posted this
-        # grad: a row-sharded sparse table legitimately gets rows from a
-        # subset of trainers in a round, but the sync average is still over
-        # all of them (dense grads always arrive from everyone, so the two
-        # counts coincide there)
+        # sparse scales by the ACTIVE trainer count, not by how many posted:
+        # a row-sharded sparse table legitimately gets rows from a subset of
+        # trainers in a round, but the sync average is still over all of
+        # them. Dense scales by the POSTED count (normally identical) so a
+        # guardrail-dropped poisoned send renormalizes to the survivors.
         n_active = max(self._active_trainers(), 1)
         for grad_name, buf in list(self._grad_buf.items()):
             vals = [buf[t] for t in sorted(buf)]
             if not vals:
                 continue
-            self._apply_update(grad_name, vals, scale=1.0 / n_active)
+            if vals[0][0] == "sparse":
+                scale = 1.0 / n_active
+            else:
+                # dense grads normally arrive from every active trainer; a
+                # trainer that dropped a non-finite send (guardrails fleet
+                # hygiene) simply doesn't post this round — renormalize the
+                # average to the survivors, the same stance as the eviction
+                # path's half-round drop (_evict_locked)
+                scale = 1.0 / len(vals)
+            self._apply_update(grad_name, vals, scale=scale)
             self._grad_buf[grad_name] = {}
         self._step += 1
 
@@ -953,6 +985,8 @@ def send_delta_sections(client, name: str, delta, epmap, sections) -> None:
     slicing math cannot drift from send_sections. NOT retried at this layer:
     the server ADDS deltas, so an ambiguous re-send would double-apply —
     geo's rebase-on-pull makes a lost push self-correcting instead."""
+    if _guard_drops_send(name, delta):
+        return
     for ep, wire, part in iter_sections(name, delta, epmap, sections):
         client._call(ep, {"op": "send", "name": wire,
                           "trainer": client.trainer_id, "kind": "delta"},

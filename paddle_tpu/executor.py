@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import time
+import warnings
 import weakref
 from typing import Any, Sequence
 
@@ -37,6 +38,7 @@ from . import flags, profiler
 from .framework import OpError, Program, Variable, default_main_program
 from .ops.registry import ExecContext, get_op_def
 from .resilience.faults import fault_point
+from .resilience.guardrails import GUARD_HEALTH_NAME
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard"]
 
@@ -72,6 +74,66 @@ def _maybe_check_finite(op, outs):
                         f"output slot '{slot}' contains nan/inf "
                         f"(FLAGS_check_nan_inf)"),
                 )
+
+
+_nan_inf_jit_warned = False
+
+
+def _warn_check_nan_inf_keeps_jit():
+    """FLAGS_check_nan_inf used to silently force eager semantics on the
+    compiled path — every real training run that set it lost XLA. Now the
+    jit path is kept and this one-time warning points at the tools that do
+    work compiled."""
+    global _nan_inf_jit_warned
+    if _nan_inf_jit_warned:
+        return
+    _nan_inf_jit_warned = True
+    warnings.warn(
+        "FLAGS_check_nan_inf cannot validate per-op outputs inside a "
+        "compiled XLA step; keeping the jit path. For always-on numeric "
+        "health at full speed use the in-graph sentinel "
+        "(FLAGS_guard_numerics + resilience.guardrails.StepGuard); for "
+        "eager per-op attribution wrap the run in jax.disable_jit() — the "
+        "guard's blame replay does exactly that after a rewind.",
+        stacklevel=4)
+
+
+def _apply_numeric_faults(feed_names, feed_vals):
+    """`numeric_nan` / `numeric_spike` fault sites (resilience/faults.py):
+    the compiled step is opaque, so the feed is the injection boundary. A
+    planted NaN propagates into the loss and every gradient slot; a 1e4x
+    feed scale drives the finite loss spike the sentinel's EMA gate must
+    catch. Values change, shapes don't — the compile-cache signature (and
+    therefore the step's executable) is untouched."""
+    from .core.selected_rows import is_selected_rows
+    from .resilience.faults import InjectedFault
+
+    mode = None
+    try:
+        fault_point("numeric_nan")
+    except InjectedFault:
+        mode = "nan"
+    try:
+        fault_point("numeric_spike")
+    except InjectedFault:
+        mode = mode or "spike"
+    if mode is None:
+        return feed_vals
+    out = list(feed_vals)
+    for i, v in enumerate(out):
+        if is_selected_rows(v):
+            continue
+        arr = np.asarray(v)
+        if arr.dtype.kind != "f" or arr.size == 0:
+            continue
+        arr = np.array(arr)  # private copy; v may be a staged device array
+        if mode == "nan":
+            arr.reshape(-1)[0] = np.nan
+        else:
+            arr *= 1e4
+        out[i] = arr
+        break
+    return out
 
 
 _scope_uid = 0
@@ -432,11 +494,15 @@ class Executor:
         self.place = place
         # program -> {signature: _Compiled}
         self._cache: "weakref.WeakKeyDictionary[Program, dict]" = weakref.WeakKeyDictionary()
-        # (step id, completion token) of dispatched-but-undrained async
-        # steps (run_async window, bounded by FLAGS_max_inflight_steps);
-        # the ids feed the hang watchdog's state dump
+        # (step id, completion token, health vector) of dispatched-but-
+        # undrained async steps (run_async window, bounded by
+        # FLAGS_max_inflight_steps); the ids feed the hang watchdog's state
+        # dump, the health vectors feed the StepGuard at drain time
         self._inflight: collections.deque = collections.deque()
         self._dispatch_seq = 0
+        # numeric-guardrail policy (resilience/guardrails.StepGuard): fed
+        # each drained step's in-graph health vector; may raise GuardRewind
+        self._step_guard = None
 
     # -- public API ---------------------------------------------------------
     def run(
@@ -453,9 +519,16 @@ class Executor:
         random_seed and an op prefix draw IDENTICAL per-op keys when given
         the same counter — how the pipeline backward replay reproduces the
         forward's dropout masks exactly (parallel/pipeline.py)."""
-        outs, _ = self._run_impl(program, feed, fetch_list, scope,
-                                 return_numpy, rng_counter)
+        outs, _, _ = self._run_impl(program, feed, fetch_list, scope,
+                                    return_numpy, rng_counter)
         return outs
+
+    def set_step_guard(self, guard) -> None:
+        """Attach a resilience.guardrails.StepGuard: every drained async
+        step's in-graph health vector is handed to it; a bad-step-budget
+        overrun surfaces as GuardRewind from run_async/wait (which
+        train_from_dataset handles in place)."""
+        self._step_guard = guard
 
     def run_async(
         self,
@@ -474,11 +547,15 @@ class Executor:
         token, and once more than FLAGS_max_inflight_steps tokens are
         pending the host blocks on the OLDEST one — the only place the async
         trainer loop ever waits on the device (window boundary drain)."""
-        outs, token = self._run_impl(program, feed, fetch_list, scope,
-                                     False, rng_counter)
+        outs, token, health = self._run_impl(program, feed, fetch_list,
+                                             scope, False, rng_counter)
         if token is not None:
             self._dispatch_seq += 1
-            self._inflight.append((self._dispatch_seq, token))
+            if self._step_guard is not None and health is not None:
+                # keep the batch around until its (window-delayed) health
+                # verdict lands — the blame replay needs the poison feed
+                self._step_guard.note_dispatch(self._dispatch_seq, feed)
+            self._inflight.append((self._dispatch_seq, token, health))
             window = int(flags.get_flag("max_inflight_steps"))
             if window > 0:
                 while len(self._inflight) > window:
@@ -505,7 +582,7 @@ class Executor:
         from .resilience.faults import InjectedFault, fault_point
         from .resilience.watchdog import Watchdog, runtime_state
 
-        step_id, token = self._inflight[0]
+        step_id, token, health = self._inflight[0]
         stalled = False
         try:
             fault_point("pipeline_stall")
@@ -519,7 +596,7 @@ class Executor:
             def state():
                 return runtime_state(
                     oldest_step=step_id,
-                    inflight_step_ids=[s for s, _ in self._inflight],
+                    inflight_step_ids=[s for s, _, _ in self._inflight],
                     inflight_depth=len(self._inflight),
                     max_inflight_steps=int(
                         flags.get_flag("max_inflight_steps")))
@@ -527,6 +604,22 @@ class Executor:
             wd.wait((lambda: False) if stalled else is_ready, state,
                     what=f"Executor async step {step_id}")
         self._inflight.popleft()
+        if health is not None and self._step_guard is not None:
+            # token resolved above, so this 4-float read never blocks on
+            # compute; observe() may raise GuardRewind (budget exhausted)
+            self._step_guard.observe(self, step_id, np.asarray(health))
+
+    def drain_quiet(self):
+        """Complete every in-flight step WITHOUT guard/watchdog policy:
+        the rewind path discards the window dispatched after a poison step
+        (their state writes are about to be overwritten by the checkpoint
+        restore), so their health verdicts must not re-trigger the guard."""
+        while self._inflight:
+            _, token, _ = self._inflight.popleft()
+            try:
+                jax.block_until_ready(token)
+            except Exception:  # noqa: BLE001 — discard path
+                pass
 
     def _run_impl(
         self,
@@ -560,7 +653,7 @@ class Executor:
                     "is not supported yet — run the pipeline program "
                     "directly (dp-sharding inside stages is planned)")
             return program._pipeline.run_step(self, scope, feed,
-                                              fetch_names), None
+                                              fetch_names), None, None
 
         from .core.selected_rows import is_selected_rows
 
@@ -623,6 +716,7 @@ class Executor:
         # step, before any state is read or donated — an injected "collective
         # partner lost" fault leaves the scope untouched and retryable
         fault_point("collective.step")
+        feed_vals = _apply_numeric_faults(feed_names, feed_vals)
 
         ro_vals = tuple(self._fetch_state(scope, n) for n in comp.ro_names)
         rw_vals = tuple(self._fetch_state(scope, n) for n in comp.rw_names)
@@ -639,31 +733,35 @@ class Executor:
             key,
             scope._run_counter if rng_counter is None else int(rng_counter))
 
-        if flags.get_flag("check_nan_inf"):
-            # debug mode: run the whole block eagerly so per-op outputs are
-            # concrete and _maybe_check_finite fires with op attribution.
-            # Under shard_map the body values stay tracers even with
-            # disable_jit, so per-op attribution is unavailable — fall back to
-            # a whole-step output check below.
-            with jax.disable_jit():
-                fetches, new_rw, new_extra, token = comp.fn(
-                    tuple(feed_vals), ro_vals, rw_vals, key)
-            if getattr(comp, "spmd_mode", "gspmd") == "shard_map":
-                for group, names in ((fetches, comp.fetch_names),
-                                     (new_rw, comp.rw_names)):
-                    for n, v in zip(names, group):
-                        arr = np.asarray(v)
-                        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
-                            raise RuntimeError(
-                                f"FLAGS_check_nan_inf: non-finite value in "
-                                f"'{n}' (per-op attribution is unavailable "
-                                f"under shard_map/with_collective)")
-        else:
-            t_dispatch = time.perf_counter()
-            fetches, new_rw, new_extra, token = comp.fn(
-                tuple(feed_vals), ro_vals, rw_vals, key)
-            profiler.record_stage("pipeline.dispatch",
-                                  time.perf_counter() - t_dispatch)
+        # FLAGS_check_nan_inf per-op validation only works on concrete
+        # values: under jax.disable_jit() (the guard's blame replay, debug
+        # sessions) _maybe_check_finite fires with op attribution during the
+        # trace below. On the compiled path the flag used to silently force
+        # eager semantics; now the jit path is KEPT and a one-time warning
+        # points at the in-graph health sentinel instead.
+        check_nan = flags.get_flag("check_nan_inf")
+        eager = bool(jax.config.jax_disable_jit)
+        if check_nan and not eager:
+            _warn_check_nan_inf_keeps_jit()
+        t_dispatch = time.perf_counter()
+        fetches, new_rw, new_extra, token = comp.fn(
+            tuple(feed_vals), ro_vals, rw_vals, key)
+        profiler.record_stage("pipeline.dispatch",
+                              time.perf_counter() - t_dispatch)
+        if check_nan and eager and getattr(comp, "spmd_mode",
+                                           "gspmd") == "shard_map":
+            # under shard_map the body values stay tracers even with
+            # disable_jit, so per-op attribution is unavailable — fall back
+            # to a whole-step output check
+            for group, names in ((fetches, comp.fetch_names),
+                                 (new_rw, comp.rw_names)):
+                for n, v in zip(names, group):
+                    arr = np.asarray(v)
+                    if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                        raise RuntimeError(
+                            f"FLAGS_check_nan_inf: non-finite value in "
+                            f"'{n}' (per-op attribution is unavailable "
+                            f"under shard_map/with_collective)")
         if flags.get_flag("benchmark"):
             jax.block_until_ready((fetches, new_rw))  # reference operator.cc:926
 
@@ -672,9 +770,25 @@ class Executor:
         for n, v in zip(comp.extra_w, new_extra):
             scope.set_var(n, v)
 
+        # the in-graph health vector (resilience/guardrails.py) rides the
+        # step's outputs: hand the DEVICE array back so reading it after the
+        # completion token resolves costs a 4-float transfer, no sync here
+        health = None
+        src = getattr(comp, "health_src", "?")
+        if src == "?":  # resolve once per compiled entry
+            src = None
+            if GUARD_HEALTH_NAME in comp.extra_w:
+                src = ("extra", comp.extra_w.index(GUARD_HEALTH_NAME))
+            elif GUARD_HEALTH_NAME in comp.rw_names:
+                src = ("rw", comp.rw_names.index(GUARD_HEALTH_NAME))
+            comp.health_src = src
+        if src is not None:
+            group, idx = src
+            health = (new_extra if group == "extra" else new_rw)[idx]
+
         if return_numpy:
-            return [np.asarray(x) for x in fetches], token
-        return list(fetches), token
+            return [np.asarray(x) for x in fetches], token, health
+        return list(fetches), token, health
 
     def train_from_dataset(
         self,
@@ -686,9 +800,15 @@ class Executor:
         fetch_list=None,
         fetch_info=None,
         print_period: int = 100,
+        guard=None,
     ):
         """Consume a Dataset end-to-end (reference executor.py:894 +
         Executor::RunFromDataset, executor.cc:142).
+
+        guard: optional resilience.guardrails.StepGuard — installed via
+        set_step_guard for the run; bad-step-budget overruns are handled IN
+        the loop (checkpoint rewind + LR backoff + blame replay, then the
+        epoch continues past the poison batch).
 
         The reference spins `thread` device workers each running the program
         over its own data feed (trainer.h MultiTrainer, device_worker.h
@@ -698,6 +818,8 @@ class Executor:
         """
         if dataset is None:
             raise RuntimeError("dataset is need and should be initialized")
+        if guard is not None:
+            self.set_step_guard(guard)
         if thread:
             # reference semantics: min(dataset thread_num, thread) — but an
             # unconfigured dataset (thread_num=1 default) takes the explicit
@@ -753,12 +875,36 @@ class Executor:
                                         placement=self.feed_placer(program)))
         else:
             batches = dataset._iter_batches()
+        from .resilience.guardrails import GuardRewind
+
+        def _rewind(gr):
+            # StepGuard budget overrun: restore + LR backoff + blame replay,
+            # then keep consuming the epoch — the data cursor has already
+            # moved past the poison batch, which is exactly the skip we want
+            if self._step_guard is None:
+                raise gr
+            self._step_guard.rewind(self, gr)
+
         t0 = None
         n_batches = 0
         try:
             for feed in batches:
-                outs = self.run_async(program, feed=feed,
-                                      fetch_list=fetch_list, scope=scope)
+                try:
+                    outs = self.run_async(program, feed=feed,
+                                          fetch_list=fetch_list, scope=scope)
+                except GuardRewind as gr:
+                    _rewind(gr)
+                    continue
+                except (ValueError, TypeError) as e:
+                    if not flags.get_flag("feed_skip_corrupt"):
+                        raise
+                    # corrupt record: the batch died in ndarray conversion/
+                    # dtype cast BEFORE dispatch (state untouched) — count
+                    # it and keep the epoch alive
+                    profiler.bump("feed.skip_corrupt")
+                    print(f"[executor] skipping corrupt batch "
+                          f"(FLAGS_feed_skip_corrupt): {e}", flush=True)
+                    continue
                 n_batches += 1
                 if n_batches == 1:
                     # the first batch carries the XLA compile: let it finish
@@ -778,8 +924,14 @@ class Executor:
         finally:
             # epoch boundary: drain the window so trained state is final
             # before the dataset's _finish_to_run hook (and so an exception
-            # doesn't leave steps silently in flight)
-            self.wait()
+            # doesn't leave steps silently in flight). A bad step at the
+            # epoch tail can still trip the guard here — same handling
+            while True:
+                try:
+                    self.wait()
+                    break
+                except GuardRewind as gr:
+                    _rewind(gr)
 
     def feed_placer(self, program=None):
         """Placement fn for the DeviceLoader prefetcher: cast host batches to

@@ -126,6 +126,35 @@ _define("fault_plan", "",
         "deterministic fault-injection plan for the named runtime sites "
         "(resilience/faults.py grammar, e.g. 'ckpt.write:2;ps.send:1' or "
         "'rand:p=0.1,seed=7,max=5'); empty = injection off")
+# numeric guardrail knobs (resilience/guardrails.py, ops health_sentinel)
+_define("guard_numerics", False,
+        "append the in-graph health sentinel to every minimize(): loss "
+        "finiteness, global grad norm and found_inf are computed INSIDE the "
+        "compiled step (emitted with the async completion token, ~zero "
+        "cost), and a non-finite/spiking step's parameter update is skipped "
+        "branchlessly (the AMP found_inf skip generalized to fp32)")
+_define("guard_bad_step_budget", 3,
+        "StepGuard: consecutive bad (skipped) steps tolerated before the "
+        "guard rewinds to the last good checkpoint; the skip itself is "
+        "always in-graph and free")
+_define("guard_spike_factor", 0.0,
+        "health sentinel loss-spike gate: a finite loss greater than this "
+        "factor times the in-graph loss EMA counts as a bad step and skips "
+        "the update (e.g. 10.0); <=0 disables spike gating (non-finite "
+        "gating is always on under FLAGS_guard_numerics). Baked into the "
+        "program at minimize() time")
+_define("guard_lr_backoff", 0.5,
+        "StepGuard: multiply the learning rate by this factor after each "
+        "rewind (recovery ladder: skip -> rewind -> LR backoff -> surface); "
+        "1.0 disables the backoff")
+_define("guard_max_rewinds", 3,
+        "StepGuard: rewinds tolerated across a run before the guard stops "
+        "recovering and surfaces GuardError")
+_define("feed_skip_corrupt", False,
+        "reader robustness: a sample/batch whose ndarray conversion raises "
+        "(corrupt record) is skipped and counted on the profiler "
+        "'feed.skip_corrupt' counter instead of killing the epoch "
+        "(DataFeeder.feed, train_from_dataset, DeviceLoader placement)")
 _define("retry_max_attempts", 4,
         "RetryPolicy: attempts per call for transient RPC/IO failures")
 _define("retry_base_delay_ms", 50,

@@ -336,6 +336,73 @@ def update_loss_scaling(ctx: ExecContext):
     }
 
 
+@register_op("health_sentinel", grad="none")
+def health_sentinel(ctx: ExecContext):
+    """In-graph numeric health vector + branchless bad-step skip
+    (resilience/guardrails.py). Generalizes check_finite_and_unscale's AMP
+    found_inf skip to every fp32 run: inputs are the post-clip gradients and
+    the loss; a step whose loss/grads are non-finite — or whose finite loss
+    exceeds spike_factor times the in-graph loss EMA — has ALL its gradients
+    zeroed (the optimizer ops then leave parameters bit-identical for SGD,
+    moment-decay-only for Adam-family), and the verdict is emitted as a tiny
+    Health vector the executor ships out with the async completion token:
+
+        Health = [loss, global_grad_norm, nonfinite, bad]   (float32 [4])
+
+    State is [ema, steps_seen]; the EMA only advances on good steps so one
+    spike cannot drag the baseline up. An AMP program wires its own
+    @FOUND_INF@ in through the optional FoundInfinite input so both skip
+    mechanisms agree on one verdict."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
+    xs = ctx.inputs("X")
+    loss = ctx.input("Loss")
+    state = jnp.reshape(ctx.input("State"), (-1,)).astype(jnp.float32)
+    spike_factor = float(ctx.attr("spike_factor", 0.0))
+    ema_decay = float(ctx.attr("ema_decay", 0.9))
+
+    loss32 = jnp.mean(loss.astype(jnp.float32))  # scalar whatever the rank
+    nonfinite = ~jnp.isfinite(loss32)
+    sq = jnp.zeros((), jnp.float32)
+    for x in xs:
+        v = x.values if is_selected_rows(x) else x
+        v32 = v.astype(jnp.float32)
+        nonfinite = nonfinite | ~jnp.all(jnp.isfinite(v32))
+        sq = sq + jnp.sum(jnp.square(v32))
+    gnorm = jnp.sqrt(sq)
+    nonfinite = nonfinite | ~jnp.isfinite(gnorm)
+    amp_found = ctx.input("FoundInfinite")
+    if amp_found is not None:
+        nonfinite = nonfinite | (jnp.reshape(amp_found, ()) != 0)
+
+    ema, seen = state[0], state[1]
+    spike = jnp.zeros((), jnp.bool_)
+    if spike_factor > 0.0:
+        spike = (seen > 0) & jnp.isfinite(loss32) & (loss32 > spike_factor * ema)
+    bad = nonfinite | spike
+
+    def _gate(x):
+        if is_selected_rows(x):
+            return SelectedRows(
+                x.rows, jnp.where(bad, jnp.zeros_like(x.values), x.values),
+                x.height)
+        return jnp.where(bad, jnp.zeros_like(x), x)
+
+    ema_next = jnp.where(bad, ema,
+                         jnp.where(seen > 0,
+                                   ema_decay * ema + (1.0 - ema_decay) * loss32,
+                                   loss32))
+    seen_next = jnp.where(bad, seen, seen + 1.0)
+    health = jnp.stack([loss32, gnorm,
+                        nonfinite.astype(jnp.float32),
+                        bad.astype(jnp.float32)])
+    return {
+        "Out": [_gate(x) for x in xs],
+        "Health": health,
+        "StateOut": jnp.stack([ema_next, seen_next]),
+    }
+
+
 @register_op("dgc", grad="none", stateful_outputs=("UOut", "VOut"))
 def dgc(ctx: ExecContext):
     """Deep Gradient Compression step (reference dgc_op.h /

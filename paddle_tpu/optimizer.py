@@ -119,14 +119,34 @@ class Optimizer:
 
     # -- the transformation pipeline ----------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        # the numeric guardrail (resilience/guardrails.py) needs the loss
+        # var to build its in-graph health vector; record it on the program
+        # (the AMP decorator overwrites this with the UNSCALED loss)
+        default_main_program()._guard_loss_name = loss.name
         return append_backward(loss, parameter_list, no_grad_set)
 
     def apply_gradients(self, params_grads):
-        """clip -> regularize -> per-param update ops (optimizer.py:502)."""
+        """clip -> regularize -> [health sentinel] -> per-param update ops
+        (optimizer.py:502). Under FLAGS_guard_numerics every gradient is
+        routed through the in-graph health sentinel AFTER clipping (a NaN
+        that a global-norm clip smeared over all grads is still caught), so
+        a bad step's update ops see zeros and skip branchlessly."""
+        from .resilience import guardrails
+
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads, self.regularization)
-        return self._create_optimization_pass(params_grads)
+        if guardrails.enabled():
+            params_grads = guardrails.append_health_sentinel(params_grads)
+        ops = self._create_optimization_pass(params_grads)
+        # the StepGuard's rewind rung backs the LR off through the scope;
+        # record where the LR lives (scheduler LR vars qualify too)
+        try:
+            default_main_program()._guard_lr_name = (
+                self._global_learning_rate().name)
+        except (KeyError, AttributeError):
+            pass
+        return ops
 
     def _create_optimization_pass(self, params_grads):
         self.helper = LayerHelper(self.__class__.__name__)

@@ -91,7 +91,18 @@ class DeviceLoader:
                     return
                 profiler.record_stage("pipeline.host_ingest",
                                       time.perf_counter() - t0)
-                yield place(feed)
+                try:
+                    staged_feed = place(feed)
+                except (ValueError, TypeError):
+                    # corrupt record: the batch died in the dtype cast /
+                    # device_put — under FLAGS_feed_skip_corrupt count it
+                    # and keep prefetching instead of killing the epoch
+                    # through the consumer's re-raise
+                    if not flags.get_flag("feed_skip_corrupt"):
+                        raise
+                    profiler.bump("feed.skip_corrupt")
+                    continue
+                yield staged_feed
 
         from ..resilience.watchdog import stall_window_s
 

@@ -20,7 +20,8 @@ import jax
 from . import flags
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
-           "record_event", "record_stage", "stage_timer", "stage_counters"]
+           "record_event", "record_stage", "stage_timer", "stage_counters",
+           "bump"]
 
 
 def _resolve_dir(path: str | None) -> str:
@@ -90,6 +91,13 @@ def record_stage(stage: str, seconds: float, events: int = 1):
         c = _stage_counters.setdefault(stage, [0, 0.0])
         c[0] += events
         c[1] += seconds
+
+
+def bump(stage: str, events: int = 1):
+    """Count an event with no wall time against a named counter — the
+    robustness paths (corrupt-record skips, non-finite send drops, guard
+    skips) use these so post-mortems can see how much was dropped."""
+    record_stage(stage, 0.0, events)
 
 
 @contextlib.contextmanager

@@ -22,6 +22,12 @@ compose into a training loop that survives partial failure:
                 async executor drain and DeviceLoader; a wedged step dumps
                 in-flight state instead of hanging forever
                 (`FLAGS_watchdog_stall_s`).
+  * guardrails— numeric-fault recovery: the in-graph health sentinel
+                (`FLAGS_guard_numerics`, appended by minimize()) plus
+                `StepGuard` — bad steps skip in-graph, budget overruns
+                rewind via CheckpointManager with LR backoff, and the
+                offending step replays eagerly for an op-attributed blame
+                report (`replay_blame`).
 """
 from .faults import (  # noqa: F401
     FAULT_SITES,
@@ -36,6 +42,14 @@ from .retry import RetryPolicy, io_policy, rpc_policy  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 from .runner import CheckpointedRunner, StepFailure  # noqa: F401
 from .watchdog import StallError, Watchdog, stall_window_s  # noqa: F401
+from .guardrails import (  # noqa: F401
+    GUARD_HEALTH_NAME,
+    GUARD_STATE_NAME,
+    GuardError,
+    GuardRewind,
+    StepGuard,
+    replay_blame,
+)
 
 __all__ = [
     "FAULT_SITES", "FaultPlan", "InjectedFault", "fault_point",
@@ -43,4 +57,6 @@ __all__ = [
     "RetryPolicy", "io_policy", "rpc_policy",
     "CheckpointManager", "CheckpointedRunner", "StepFailure",
     "StallError", "Watchdog", "stall_window_s",
+    "GUARD_HEALTH_NAME", "GUARD_STATE_NAME", "GuardError", "GuardRewind",
+    "StepGuard", "replay_blame",
 ]

@@ -39,6 +39,7 @@ __all__ = ["CheckpointManager"]
 _MANIFEST = "manifest.json"
 _STATE = "state"
 _STEP_PREFIX = "step_"
+_GUARD_EVENTS = "guard_events.json"
 _FORMAT = 1
 
 
@@ -70,6 +71,12 @@ class CheckpointManager:
         self._program = main_program
         self._scope = scope
         os.makedirs(self.root, exist_ok=True)
+        # numeric-guard forensic record (resilience/guardrails.StepGuard):
+        # every skip/rewind lands here, is mirrored into each saved
+        # manifest, AND persists in root/guard_events.json — so the
+        # post-mortem survives a process restart even if no save follows
+        # the event. steps()/latest_step() never see this file.
+        self._guard_events: list[dict] = self._load_guard_events()
 
     # -- context defaults ----------------------------------------------------
     def _resolve(self, main_program, scope):
@@ -109,6 +116,47 @@ class CheckpointManager:
         with open(os.path.join(self._step_dir(step), _MANIFEST)) as f:
             return json.load(f)
 
+    # -- guard events --------------------------------------------------------
+    def _events_path(self) -> str:
+        return os.path.join(self.root, _GUARD_EVENTS)
+
+    def _load_guard_events(self) -> list[dict]:
+        try:
+            with open(self._events_path()) as f:
+                data = json.load(f)
+            return list(data) if isinstance(data, list) else []
+        except (OSError, ValueError):
+            return []
+
+    def record_guard_event(self, step: int, reason: str, action: str,
+                           detail=None) -> dict:
+        """Append one numeric-guard event (skip/rewind/surface). Durable
+        immediately via an atomic write of guard_events.json; also embedded
+        in every later manifest. latest_step() is unaffected."""
+        evt = {"step": int(step), "reason": str(reason),
+               "action": str(action), "time": time.time()}
+        if detail is not None:
+            evt["detail"] = detail
+        self._guard_events.append(evt)
+        tmp = self._events_path() + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                # default=str: blame reports may carry non-JSON leaves
+                json.dump(self._guard_events, f, indent=1, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._events_path())
+        except OSError:
+            # forensics must never take training down with them
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return evt
+
+    def guard_events(self) -> list[dict]:
+        return list(self._guard_events)
+
     # -- save ----------------------------------------------------------------
     def save(self, step: int, executor=None, main_program=None,
              scope=None) -> str:
@@ -147,6 +195,8 @@ class CheckpointManager:
                     v.name for v in program.list_vars()
                     if getattr(v, "persistable", False)
                     and scope.has_var(v.name)),
+                "guard_events": json.loads(
+                    json.dumps(self._guard_events, default=str)),
                 "time": time.time(),
             }
             mpath = os.path.join(tmp, _MANIFEST)

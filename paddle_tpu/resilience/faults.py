@@ -22,6 +22,15 @@ host, reproducibly. This module plants named *sites* in the hot paths —
     pipeline_stall    Executor's async completion-token drain and the
                       DeviceLoader producer — the wait wedges as if the
                       device/feed hung, so the resilience watchdog must fire
+    numeric_nan       Executor feed staging — a NaN is planted in the step's
+                      first floating feed (the compiled step is opaque, so
+                      the feed is the injection boundary); it propagates into
+                      the loss and every gradient slot, which the in-graph
+                      health sentinel must catch and skip
+    numeric_spike     Executor feed staging — the first floating feed is
+                      scaled 1e4x, driving a finite loss spike that the
+                      sentinel's EMA gate (FLAGS_guard_spike_factor) must
+                      catch
 
 — and a *plan* that decides, per site and per hit, whether to raise an
 `InjectedFault`. Plans are either explicit hit schedules or seeded Bernoulli
@@ -52,6 +61,7 @@ __all__ = ["FAULT_SITES", "InjectedFault", "FaultPlan", "fault_point",
 FAULT_SITES = frozenset({
     "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
     "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
+    "numeric_nan", "numeric_spike",
 })
 
 

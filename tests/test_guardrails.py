@@ -1,0 +1,399 @@
+"""Numeric guardrails (resilience/guardrails.py, ISSUE 4): in-graph health
+sentinel + branchless bad-step skip, StepGuard budget/rewind/LR-backoff
+ladder, eager blame replay, reader corrupt-record skipping, fleet hygiene
+(non-finite send drops + pserver renormalization), and the numeric fault
+sites/chaos drill.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu import layers as L
+from paddle_tpu.resilience import (
+    GUARD_HEALTH_NAME,
+    CheckpointManager,
+    GuardRewind,
+    StepGuard,
+    fault_scope,
+)
+from paddle_tpu.resilience.guardrails import H_BAD, H_GNORM, H_NONFINITE
+
+
+@pytest.fixture()
+def restore_flags():
+    snap = pt.flags.all_flags()
+    yield
+    pt.flags.set_flags(snap)
+
+
+def _sgd_program(lr=0.1):
+    x = L.data(name="x", shape=[4], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+    pt.optimizer.SGD(lr).minimize(loss)
+    return loss
+
+
+def _feed(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.standard_normal((8, 4)).astype(np.float32),
+            "y": np.ones((8, 1), np.float32)}
+
+
+def _nan_feed(seed=0):
+    f = _feed(seed)
+    bx = f["x"].copy()
+    bx[0, 0] = np.nan
+    f["x"] = bx
+    return f
+
+
+# -- in-graph sentinel: skip semantics ----------------------------------------
+
+def test_nan_step_skipped_bit_exact(restore_flags):
+    """The acceptance contract: an injected NaN leaves parameters BIT
+    identical (SGD sees zeroed grads), health records the verdict, and the
+    next healthy step trains normally — no interpreter fallback anywhere."""
+    flags.set_flags({"guard_numerics": True})
+    loss = _sgd_program()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    assert main.global_block.var(GUARD_HEALTH_NAME) is not None
+    exe = pt.Executor()
+    scope = pt.global_scope()
+    exe.run(startup)
+    w = main.all_parameters()[0].name
+
+    exe.run(main, feed=_feed(), fetch_list=[loss])
+    w1 = np.asarray(scope.find_var(w)).copy()
+    h1 = np.asarray(scope.find_var(GUARD_HEALTH_NAME))
+    assert h1[H_BAD] == 0 and np.isfinite(h1[H_GNORM])
+
+    exe.run(main, feed=_nan_feed(), fetch_list=[loss])
+    w2 = np.asarray(scope.find_var(w))
+    h2 = np.asarray(scope.find_var(GUARD_HEALTH_NAME))
+    assert h2[H_NONFINITE] == 1 and h2[H_BAD] == 1
+    np.testing.assert_array_equal(w1, w2)  # bit-exact skip
+
+    exe.run(main, feed=_feed(1), fetch_list=[loss])
+    assert not np.array_equal(w2, np.asarray(scope.find_var(w)))
+    assert np.isfinite(np.asarray(scope.find_var(w))).all()
+
+
+def test_spike_step_skipped_by_ema_gate(restore_flags):
+    flags.set_flags({"guard_numerics": True, "guard_spike_factor": 10.0})
+    loss = _sgd_program(lr=0.01)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    exe = pt.Executor()
+    scope = pt.global_scope()
+    exe.run(startup)
+    w = main.all_parameters()[0].name
+    for i in range(3):  # establish the loss EMA
+        exe.run(main, feed=_feed(i), fetch_list=[loss])
+    w_before = np.asarray(scope.find_var(w)).copy()
+    spike = _feed(0)
+    spike["x"] = spike["x"] * 1e4
+    exe.run(main, feed=spike, fetch_list=[loss])
+    h = np.asarray(scope.find_var(GUARD_HEALTH_NAME))
+    assert h[H_BAD] == 1 and h[H_NONFINITE] == 0  # finite, but a spike
+    np.testing.assert_array_equal(w_before, np.asarray(scope.find_var(w)))
+
+
+def test_guard_off_appends_nothing(restore_flags):
+    flags.set_flags({"guard_numerics": False})
+    _sgd_program()
+    main = pt.default_main_program()
+    assert GUARD_HEALTH_NAME not in main.global_block.vars
+    assert not any(op.type == "health_sentinel"
+                   for op in main.global_block.ops)
+
+
+# -- StepGuard: budget, rewind, blame -----------------------------------------
+
+def test_budget_exhausted_rewinds_and_attributes_blame(tmp_path,
+                                                       restore_flags):
+    flags.set_flags({"guard_numerics": True, "max_inflight_steps": 1})
+    loss = _sgd_program()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    exe = pt.Executor()
+    scope = pt.global_scope()
+    exe.run(startup)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), main_program=main,
+                            scope=scope)
+    exe.run(main, feed=_feed(), fetch_list=[loss])
+    mgr.save(0, executor=exe)
+    w = main.all_parameters()[0].name
+    w_ckpt = np.asarray(scope.find_var(w)).copy()
+    lr_name = main._guard_lr_name
+    lr0 = float(np.asarray(scope.find_var(lr_name)).reshape(-1)[0])
+
+    guard = StepGuard(mgr, budget=1, program=main, scope=scope)
+    exe.set_step_guard(guard)
+    report = None
+    for _ in range(4):
+        try:
+            exe.run_async(main, feed=_nan_feed(), fetch_list=[loss])
+        except GuardRewind as gr:
+            report = guard.rewind(exe, gr)
+            break
+    exe.wait()
+    assert report is not None, "consecutive bad steps never tripped the guard"
+    # replay reproduced the fault eagerly with op attribution
+    assert report["op_type"] is not None
+    assert "nan/inf" in report["detail"]
+    assert report["var"] is not None
+    # state rewound to the checkpoint, LR backed off
+    np.testing.assert_array_equal(w_ckpt, np.asarray(scope.find_var(w)))
+    lr1 = float(np.asarray(scope.find_var(lr_name)).reshape(-1)[0])
+    assert lr1 == pytest.approx(lr0 * 0.5)
+    # forensics: skips then a rewind, durably recorded
+    actions = [e["action"] for e in mgr.guard_events()]
+    assert actions.count("skip") == 2 and actions[-1] == "rewind"
+    mgr.save(1, executor=exe)
+    assert len(mgr.read_manifest(1)["guard_events"]) == len(actions)
+    assert mgr.latest_step() == 1
+
+
+def test_guard_events_survive_restart(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root)
+    mgr.record_guard_event(7, "nonfinite", "skip", {"loss": float("nan")})
+    fresh = CheckpointManager(root)  # a restarted process
+    evts = fresh.guard_events()
+    assert len(evts) == 1 and evts[0]["step"] == 7
+    assert fresh.latest_step() is None  # events never masquerade as steps
+
+
+# -- AMP composition ----------------------------------------------------------
+
+def test_amp_dynamic_loss_scaling_composes(restore_flags):
+    """AMP's own found_inf machinery must keep working under the guard: the
+    scale still decrements on overflow, and the sentinel sees AMP's verdict
+    (health reports the bad step) without double-updating anything."""
+    from paddle_tpu.contrib import mixed_precision as amp
+
+    flags.set_flags({"guard_numerics": True})
+    x = L.data(name="x", shape=[8], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+    opt = amp.decorate(pt.optimizer.SGD(0.01), init_loss_scaling=2.0 ** 15,
+                       use_dynamic_loss_scaling=True,
+                       decr_every_n_nan_or_inf=1)
+    opt.minimize(loss)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    exe = pt.Executor()
+    scope = pt.global_scope()
+    exe.run(startup)
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((8, 8)).astype(np.float32)
+    yv = np.ones((8, 1), np.float32)
+
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    s1 = float(np.asarray(scope.find_var("@LOSS_SCALING@")).reshape(-1)[0])
+    exe.run(main, feed={"x": np.full((8, 8), 1e30, np.float32), "y": yv},
+            fetch_list=[loss])
+    s2 = float(np.asarray(scope.find_var("@LOSS_SCALING@")).reshape(-1)[0])
+    assert s2 < s1  # AMP state machine untouched by the sentinel
+    h = np.asarray(scope.find_var(GUARD_HEALTH_NAME))
+    assert h[H_BAD] == 1  # and the sentinel heard AMP's verdict
+    (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    assert np.isfinite(float(lv))
+
+
+# -- FLAGS_check_nan_inf compiled-path fix ------------------------------------
+
+def test_check_nan_inf_keeps_jit_and_warns_once(restore_flags):
+    loss = _sgd_program()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    exe = pt.Executor()
+    exe.run(startup)
+    flags.set_flags({"check_nan_inf": True})
+    import paddle_tpu.executor as executor_mod
+    executor_mod._nan_inf_jit_warned = False
+    with pytest.warns(UserWarning, match="health sentinel|guard_numerics"):
+        (lv,) = exe.run(main, feed=_nan_feed(), fetch_list=[loss])
+    assert not np.isfinite(float(lv))  # jit path kept: NaN flows, no raise
+    # eager mode still gives per-op attribution (the blame-replay contract)
+    with jax.disable_jit():
+        with pytest.raises(pt.OpError, match="nan/inf"):
+            exe.run(main, feed=_nan_feed(), fetch_list=[loss])
+
+
+# -- numeric fault sites ------------------------------------------------------
+
+def test_numeric_fault_sites_poison_deterministically(restore_flags):
+    flags.set_flags({"guard_numerics": True})
+    loss = _sgd_program()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    exe = pt.Executor()
+    scope = pt.global_scope()
+    exe.run(startup)
+    with fault_scope("numeric_nan:2") as plan:
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert np.asarray(scope.find_var(GUARD_HEALTH_NAME))[H_BAD] == 0
+        exe.run(main, feed=_feed(), fetch_list=[loss])  # hit 2: poisoned
+        h = np.asarray(scope.find_var(GUARD_HEALTH_NAME))
+        assert h[H_NONFINITE] == 1  # the planted NaN reached the sentinel
+    assert ("numeric_nan", 2) in plan.stats()["fired"]
+    assert np.isfinite(
+        np.asarray(scope.find_var(main.all_parameters()[0].name))).all()
+
+
+@pytest.mark.chaos
+def test_numeric_chaos_drill(restore_flags):
+    """The kill-free gate.py --chaos drill: seeded NaN + spike faults under
+    the guard; epoch completes finite with both skips recorded."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import chaos
+
+    out = chaos.run_numeric_smoke(steps=6)
+    assert out["rewinds"] == 0 and out["skips"] >= 2
+
+
+# -- reader robustness --------------------------------------------------------
+
+def test_datafeeder_skips_corrupt_sample(restore_flags):
+    from paddle_tpu import profiler
+
+    x = L.data(name="x", shape=[3], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    good = ([1.0, 2.0, 3.0], [1.0])
+    corrupt = (["not", "a", "float"], [0.0])
+    feeder = pt.DataFeeder([x, y])
+    with pytest.raises(ValueError):
+        feeder.feed([good, corrupt])  # default: corrupt record raises
+
+    flags.set_flags({"feed_skip_corrupt": True})
+    profiler.stage_counters(reset=True)
+    out = feeder.feed([good, corrupt, good])
+    assert out["x"].shape == (2, 3)  # the corrupt sample is gone
+    counters = profiler.stage_counters()
+    assert counters["feed.skip_corrupt"]["events"] == 1
+    with pytest.raises(ValueError, match="every sample"):
+        feeder.feed([corrupt])  # an all-corrupt batch still surfaces
+
+
+def test_device_loader_skips_corrupt_batch(restore_flags):
+    from paddle_tpu import profiler
+    from paddle_tpu.pipeline import DeviceLoader
+    from paddle_tpu.pipeline.device_loader import default_placement
+
+    x = L.data(name="x", shape=[2], dtype="float32")
+    feeds = [{"x": np.ones((2, 2), np.float32)},
+             {"x": np.array([["bad", "row"]], dtype=object)},
+             {"x": np.full((2, 2), 2.0, np.float32)}]
+    flags.set_flags({"feed_skip_corrupt": True})
+    profiler.stage_counters(reset=True)
+    loader = DeviceLoader(lambda: iter(feeds), depth=2,
+                          placement=default_placement([x]))
+    seen = [np.asarray(f["x"])[0, 0] for f in loader]
+    assert seen == [1.0, 2.0]
+    assert profiler.stage_counters()["feed.skip_corrupt"]["events"] == 1
+
+
+def test_train_from_dataset_survives_guard(tmp_path, restore_flags):
+    """End-to-end: numeric_nan injected mid-epoch through the async
+    train_from_dataset path — the epoch completes, state stays finite, the
+    guard logs the skip."""
+    flags.set_flags({"guard_numerics": True, "max_inflight_steps": 2,
+                     "device_prefetch_depth": 2})
+    x = L.data(name="x", shape=[4], dtype="float32")
+    y = L.data(name="y", shape=[1], dtype="float32")
+    loss = L.mean(L.square_error_cost(L.fc(x, size=1), y))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    rng = np.random.default_rng(0)
+    path = tmp_path / "part-0"
+    with open(path, "w") as f:
+        for _ in range(24):  # 6 batches of 4
+            vals = " ".join(f"{v:.4f}" for v in rng.random(4))
+            f.write(f"4 {vals} 1 {rng.integers(0, 2)}\n")
+    ds = pt.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([x, y])
+    ds.set_filelist([str(path)])
+    exe = pt.Executor()
+    scope = pt.global_scope()
+    exe.run(startup)
+    guard = StepGuard(CheckpointManager(str(tmp_path / "ckpt"),
+                                        main_program=main, scope=scope),
+                      program=main, scope=scope)
+    with fault_scope("numeric_nan:3"):
+        exe.train_from_dataset(main, ds, print_period=10 ** 9, guard=guard)
+    assert guard.skips == 1 and guard.rewinds == 0
+    w = np.asarray(scope.find_var(main.all_parameters()[0].name))
+    assert np.isfinite(w).all()
+
+
+# -- fleet hygiene ------------------------------------------------------------
+
+class _RecordingClient:
+    trainer_id = 0
+
+    def __init__(self):
+        self.sent = []
+
+    def send_var(self, ep, name, value):
+        self.sent.append((ep, name))
+
+
+def test_sync_send_drops_nonfinite(restore_flags):
+    from paddle_tpu.distributed.ps_rpc import send_sections
+
+    client = _RecordingClient()
+    bad = np.array([1.0, np.nan], np.float32)
+    good = np.array([1.0, 2.0], np.float32)
+    flags.set_flags({"guard_numerics": True})
+    send_sections(client, "w@GRAD", bad, ["ep0"], [])
+    assert client.sent == []  # poison never reached the wire
+    send_sections(client, "w@GRAD", good, ["ep0"], [])
+    assert client.sent == [("ep0", "w@GRAD")]
+    # hygiene is opt-in with the guard: off means ship as before
+    flags.set_flags({"guard_numerics": False})
+    send_sections(client, "w@GRAD", bad, ["ep0"], [])
+    assert len(client.sent) == 2
+
+
+def test_communicator_drops_nonfinite_merged_send(restore_flags):
+    from paddle_tpu.distributed.communicator import Communicator
+
+    flags.set_flags({"guard_numerics": True})
+    client = _RecordingClient()
+    comm = Communicator(
+        {"w@GRAD": {"epmap": ["ep0"], "sections": []}}, {}, client,
+        pt.global_scope())
+    ctx = comm.send_ctx["w@GRAD"]
+    comm._send_merged("w@GRAD", ctx,
+                      [np.array([1.0, np.nan], np.float32),
+                       np.array([1.0, 1.0], np.float32)])
+    assert client.sent == []  # one poisoned grad poisons the merge: dropped
+    comm._send_merged("w@GRAD", ctx, [np.array([1.0, 1.0], np.float32)])
+    assert client.sent == [("ep0", "w@GRAD")]
+
+
+def test_pserver_round_renormalizes_to_posting_trainers(restore_flags):
+    """The survivors' round stays a true mean when a trainer dropped its
+    poisoned dense send: scale is 1/len(posted), not 1/n_active (sparse
+    keeps 1/n_active — partial posting is legitimate there)."""
+    from paddle_tpu.distributed.ps_rpc import PServerRuntime
+
+    ps = PServerRuntime("127.0.0.1:0", n_trainers=2, sync_mode=True,
+                        blocks=[], scope=pt.Scope(), executor=None)
+    applied = []
+    ps._apply_update = lambda name, vals, scale, trainer=None: applied.append(
+        (name, len(vals), scale))
+    ps._grad_buf = {
+        "dense@GRAD": {1: ("dense", np.ones(2, np.float32))},  # trainer 0
+                                                               # dropped
+        "table@GRAD": {0: ("sparse", np.zeros(1, np.int64),
+                           np.ones((1, 2), np.float32), 10)},
+    }
+    ps._run_round()
+    by_name = {n: (k, s) for n, k, s in applied}
+    assert by_name["dense@GRAD"] == (1, 1.0)   # renormalized to survivors
+    assert by_name["table@GRAD"] == (1, 0.5)   # sparse: still 1/n_active

@@ -15,6 +15,11 @@ plan string.
     python tools/chaos.py --stall   # hang-watchdog smoke: an injected
                                     # pipeline_stall must raise StallError
                                     # (with a state dump), never hang
+    python tools/chaos.py --numeric # numeric-guardrail drill: seeded
+                                    # numeric_nan/numeric_spike faults
+                                    # under FLAGS_guard_numerics — the
+                                    # epoch must finish finite with the
+                                    # poisoned updates skipped in-graph
 
 Exit code 0 = survived + trajectory matched; 1 = divergence or crash.
 The `chaos` pytest marker (tests/test_chaos.py, tests/test_liveness.py)
@@ -141,6 +146,58 @@ def run_stall_smoke(window_s: float = 0.3) -> dict:
         flags.set_flags({"watchdog_stall_s": old})
 
 
+def run_numeric_smoke(steps: int = 8, seed: int = 0) -> dict:
+    """Numeric-guardrail drill (kill-free): train under seeded numeric_nan
+    and numeric_spike faults with FLAGS_guard_numerics on. The in-graph
+    sentinel must skip both poisoned updates (params/loss stay finite, no
+    rewind needed for isolated bad steps) and the StepGuard must record the
+    skip events. Returns {skips, rewinds, final_loss, events}."""
+    import paddle_tpu as pt
+    from paddle_tpu import flags
+    from paddle_tpu.resilience import (CheckpointManager, StepGuard,
+                                       fault_scope)
+
+    old = {k: flags.get_flag(k) for k in
+           ("guard_numerics", "guard_spike_factor", "max_inflight_steps")}
+    flags.set_flags({"guard_numerics": True, "guard_spike_factor": 50.0,
+                     "max_inflight_steps": 2})
+    try:
+        main_p, startup, loss = _build(seed)
+        with pt.scope_guard(pt.Scope()) as scope:
+            exe = pt.Executor()
+            exe.run(startup)
+            root = tempfile.mkdtemp(prefix="chaos_numeric_")
+            mgr = CheckpointManager(root, main_program=main_p, scope=scope)
+            guard = StepGuard(mgr, program=main_p, scope=scope)
+            exe.set_step_guard(guard)
+            # one healthy step, then the rewind anchor the guard would need
+            exe.run(main_p, feed=_feed_fn(0), fetch_list=[loss])
+            mgr.save(0, executor=exe)
+            # hits count per _run_impl inside the scope: NaN poisons step 3,
+            # the 1e4x spike hits step 5 — both isolated, so skips only
+            with fault_scope("numeric_nan:3;numeric_spike:5"):
+                for step in range(1, steps + 1):
+                    exe.run_async(main_p, feed=_feed_fn(step),
+                                  fetch_list=[loss])
+                exe.wait()
+            (lv,) = exe.run(main_p, feed=_feed_fn(steps + 1),
+                            fetch_list=[loss])
+            final = float(np.asarray(lv).reshape(-1)[0])
+            w = np.asarray(scope.find_var(main_p.all_parameters()[0].name))
+    finally:
+        flags.set_flags(old)
+    assert np.isfinite(final), f"final loss not finite: {final}"
+    assert np.isfinite(w).all(), "parameters poisoned despite the guard"
+    assert guard.skips >= 2, f"expected >=2 skip events, saw {guard.skips}"
+    assert guard.rewinds == 0, (
+        f"isolated bad steps must not exhaust the budget "
+        f"(rewinds={guard.rewinds})")
+    reasons = {e["reason"] for e in guard.events}
+    assert "nonfinite" in reasons and "loss_spike" in reasons, reasons
+    return {"skips": guard.skips, "rewinds": guard.rewinds,
+            "final_loss": final, "events": guard.events}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -157,7 +214,22 @@ def main(argv=None) -> int:
     ap.add_argument("--stall", action="store_true",
                     help="run the hang-watchdog smoke instead of the "
                          "fault-plan trajectory check")
+    ap.add_argument("--numeric", action="store_true",
+                    help="run the numeric-guardrail drill (seeded "
+                         "numeric_nan/numeric_spike under "
+                         "FLAGS_guard_numerics)")
     args = ap.parse_args(argv)
+
+    if args.numeric:
+        try:
+            out = run_numeric_smoke(steps=args.steps, seed=args.seed)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"NUMERIC DRILL FAILED: {e}", file=sys.stderr)
+            return 1
+        print(f"OK: guard skipped {out['skips']} poisoned step(s) "
+              f"({[e['reason'] for e in out['events']]}), 0 rewinds, "
+              f"final loss {out['final_loss']:.5f} finite")
+        return 0
 
     if args.stall:
         try:

@@ -29,6 +29,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # subsystem exists to prevent (ISSUE 2 acceptance line)
 DEEPFM_RATIO_FLOOR = 0.9
 
+# the in-graph health sentinel (FLAGS_guard_numerics) must stay ~free: above
+# this, the guard itself is the perf bug (ISSUE 4 acceptance line)
+GUARD_OVERHEAD_CEIL_PCT = 2.0
+
 
 def run_suite() -> int:
     print("[gate] running test suite ...", flush=True)
@@ -123,6 +127,18 @@ def check_bench(path: str | None = None) -> int:
               f"regressed; judge against deepfm_windows_ex_s spread "
               f"(PERF.md r5) before blaming code", flush=True)
         return 1
+    guard_pct = data.get("deepfm_guard_overhead_pct")
+    if guard_pct is not None:
+        print(f"[gate] bench {os.path.basename(path)}: health-sentinel "
+              f"overhead {guard_pct}% vs the unguarded device path",
+              flush=True)
+        if guard_pct > GUARD_OVERHEAD_CEIL_PCT:
+            print(f"[gate] FAIL: the in-graph health sentinel costs "
+                  f"{guard_pct}% (> {GUARD_OVERHEAD_CEIL_PCT}%) of device "
+                  f"throughput — the guard must stay ~free; check what the "
+                  f"sentinel op compiled into (and the measurement spread) "
+                  f"before blaming code", flush=True)
+            return 1
     return 0
 
 
