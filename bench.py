@@ -160,13 +160,40 @@ def bench_bert_long(on_tpu: bool):
 
 
 def bench_resnet(on_tpu: bool, peak: float):
+    """ResNet-50 row with an in-artifact lever A/B (PERF.md r6): the step is
+    timed twice — conv levers OFF (direct conv + two-pass BN, the r5
+    configuration) and ON (FLAGS_conv_implicit_gemm auto + fused one-pass
+    BN statistics) — and the headline takes the faster arm, with both
+    recorded so every round re-measures the levers end-to-end (the same
+    keep-it-honest protocol as the bert_s512 pallas rows)."""
+    from paddle_tpu import flags as pt_flags
+
+    arms = {}
+    saved = {k: pt_flags.get_flag(k)
+             for k in ("conv_implicit_gemm", "bn_fuse_stats")}
+    try:
+        for name, (igemm, fuse) in (("baseline", ("off", False)),
+                                    ("levered", ("auto", True))):
+            pt_flags.set_flags({"conv_implicit_gemm": igemm,
+                                "bn_fuse_stats": fuse})
+            arms[name] = _resnet_arm(on_tpu, peak)
+    finally:
+        pt_flags.set_flags(saved)
+    best = max(arms, key=lambda k: arms[k][0])
+    img_s, mfu, windows = arms[best]
+    ab = {f"{k}_img_s": round(v[0], 1) for k, v in arms.items()}
+    ab["winner"] = best
+    return img_s, mfu, windows, ab
+
+
+def _resnet_arm(on_tpu: bool, peak: float):
     import paddle_tpu as pt
     from paddle_tpu.models import resnet
 
     batch, iters = (128, 50) if on_tpu else (4, 3)
     size = 224 if on_tpu else 32
     main_p, startup = pt.Program(), pt.Program()
-    with pt.program_guard(main_p, startup):
+    with pt.program_guard(main_p, startup), pt.unique_name.guard():
         from paddle_tpu import layers as L
 
         img_shape = [size, size, 3] if on_tpu else [3, size, size]
@@ -444,7 +471,7 @@ def main():
     peak = _peak_flops(dev)
 
     tok_s, bert_mfu, bert_windows = bench_bert(on_tpu, peak)
-    img_s, rn_mfu, rn_windows = bench_resnet(on_tpu, peak)
+    img_s, rn_mfu, rn_windows, rn_ab = bench_resnet(on_tpu, peak)
     wmt_tok_s, wmt_mfu, wmt_windows = bench_wmt(on_tpu, peak)
     ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = bench_deepfm(on_tpu)
     long_ctx = bench_bert_long(on_tpu)
@@ -483,6 +510,10 @@ def main():
         "resnet50_images_per_sec_per_chip": round(img_s, 2),
         "resnet50_windows_img_s": rn_windows,
         "resnet50_mfu": round(rn_mfu, 4),
+        # the r6 conv-lever A/B, re-measured every round: implicit-GEMM
+        # (auto per-shape cost model) + fused one-pass BN statistics vs the
+        # r5 direct-conv/two-pass-BN step; headline takes the winner
+        "resnet50_lever_ab": rn_ab,
         "transformer_wmt_tokens_per_sec_per_chip": round(wmt_tok_s, 2),
         "transformer_wmt_windows_tok_s": wmt_windows,
         "transformer_wmt_mfu": round(wmt_mfu, 4),

@@ -26,6 +26,11 @@ from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from . import transpiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import net_drawer  # noqa: F401
+from . import install_check  # noqa: F401
+from . import passes  # noqa: F401
 from . import distributed  # noqa: F401
 from . import inference  # noqa: F401
 from . import dygraph  # noqa: F401

@@ -49,6 +49,10 @@ gray_list = {
     "unsqueeze2", "stack", "scale", "lookup_table", "lookup_table_v2",
     "layer_norm", "softmax", "softmax_mask_fuse_upper_triangle",
     "batch_norm",
+    # fused conv+BN (passes.fuse_conv_bn_stats) normally post-dates the AMP
+    # rewrite, but a manually-fused program must follow the batch_norm rule:
+    # fp32 statistics live INSIDE the kernel, boundaries follow the inputs
+    "conv2d_bn",
     # gray since r5: the op upcasts to fp32 INTERNALLY (classic path) or
     # keeps fp32 statistics in-kernel (Pallas path) — black-listing it
     # doubled the lm-head logits traffic at BERT vocab sizes

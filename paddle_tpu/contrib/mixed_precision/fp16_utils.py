@@ -24,6 +24,7 @@ _LOW = {"bfloat16": "@BF16", "float16": "@FP16"}
 # training, and the "fp32" stat vars would flip dtype in checkpoints.
 _STATE_SLOTS = {
     "batch_norm": {"Mean", "Variance"},
+    "conv2d_bn": {"Mean", "Variance"},
     "fake_quantize_dequantize_moving_average_abs_max": {"InScale"},
 }
 

@@ -39,6 +39,7 @@ __all__ = [
     "Linear",
     "FC",
     "Conv2D",
+    "Conv2DTranspose",
     "Pool2D",
     "Embedding",
     "BatchNorm",
@@ -497,7 +498,78 @@ class Linear(Layer):
         return out
 
 
-FC = Linear
+class FC(Layer):
+    """reference dygraph/nn.py:773 FC — the pre-Linear eager dense layer:
+    the weight is created LAZILY at the first forward from the input's
+    trailing dims (`[prod(shape[num_flatten_dims:]), size]`), with
+    `num_flatten_dims` controlling the matmul's row/col split exactly like
+    the static `layers.fc`."""
+
+    def __init__(self, size, num_flatten_dims=1, act=None, dtype="float32",
+                 bias_attr=None):
+        super().__init__()
+        self._size = int(size)
+        self._num_flatten_dims = int(num_flatten_dims)
+        self._dtype = dtype
+        self._act = act
+        self._with_bias = bias_attr is not False
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x: VarBase) -> VarBase:
+        nfd = self._num_flatten_dims
+        if nfd < 0:
+            nfd += len(x.shape)
+        if self.weight is None:
+            in_dim = 1
+            for d in x.shape[nfd:]:
+                in_dim *= int(d)
+            self.weight = self.add_parameter(
+                "weight",
+                self.create_parameter([in_dim, self._size], self._dtype))
+            if self._with_bias:
+                self.bias = self.add_parameter(
+                    "bias", self.create_parameter([self._size], self._dtype,
+                                                  is_bias=True))
+        out = _dy_op("mul", {"X": [x], "Y": [self.weight]},
+                     attrs={"x_num_col_dims": nfd})["Out"]
+        if self.bias is not None:
+            out = _dy_op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         attrs={"axis": -1})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Conv2DTranspose(Layer):
+    """reference dygraph/nn.py:1964 Conv2DTranspose (NCHW; filter layout
+    [C_in, C_out, kh, kw] like the static conv2d_transpose layer)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, act=None, dtype="float32"):
+        super().__init__()
+        k = (filter_size if isinstance(filter_size, (tuple, list))
+             else (filter_size, filter_size))
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [num_channels, num_filters, k[0], k[1]], dtype))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], dtype, is_bias=True))
+        _2 = lambda v: list(v) if isinstance(v, (tuple, list)) else [v] * 2
+        self._attrs = {"strides": _2(stride), "paddings": _2(padding),
+                       "dilations": _2(dilation)}
+        self._act = act
+
+    def forward(self, x: VarBase) -> VarBase:
+        out = _dy_op("conv2d_transpose",
+                     {"Input": [x], "Filter": [self.weight]},
+                     attrs=dict(self._attrs))["Output"]
+        bias = _dy_op("reshape2", {"X": [self.bias]},
+                      attrs={"shape": [1, -1, 1, 1]})["Out"]
+        out = _dy_op("elementwise_add", {"X": [out], "Y": [bias]})["Out"]
+        if self._act:
+            out = _dy_op(self._act, {"X": [out]})["Out"]
+        return out
 
 
 class Conv2D(Layer):

@@ -1,0 +1,145 @@
+"""In-program metric evaluators with persistent accumulation state.
+
+Parity with /root/reference/python/paddle/fluid/evaluator.py (Evaluator:40,
+ChunkEvaluator:118, EditDistance:189): each evaluator appends its per-batch
+metric ops AND running-sum accumulator updates to the current main program at
+construction time, so every `Executor.run` of the program advances the
+states; `reset()` zeroes them between passes and `eval()` folds the
+accumulated counts into the epoch metric. The reference deprecation note
+holds here too — `metrics.py` classes are the host-side successors — but the
+in-program form stays useful when the metric must ride the compiled step
+(one fetch per epoch instead of per batch).
+
+Departure: `eval()` reads the accumulated state from the scope and finishes
+the arithmetic on host instead of building a second program — the states are
+a handful of scalars, and this keeps eval() callable mid-epoch without
+recompilation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .framework import Program, program_guard
+from .initializer import Constant
+from .layer_helper import LayerHelper
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator:
+    """Base evaluator (reference evaluator.py:40): owns persistable state
+    vars updated by ops this evaluator appended to the main program."""
+
+    def __init__(self, name, **kwargs):
+        self.helper = LayerHelper(name, **kwargs)
+        self.states: list = []
+        self.metrics: list = []
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_or_get_global_variable(
+            f"{self.helper.name}.{suffix}", list(shape), dtype,
+            initializer=Constant(0.0))
+        self.states.append(state)
+        return state
+
+    def reset(self, executor, reset_program=None):
+        """Zero every accumulation state (reference evaluator.py:57)."""
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program):
+            for state in self.states:
+                layers.fill_constant(
+                    shape=state.shape, dtype=state.dtype.value, value=0.0,
+                    out=state)
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+    def _state_value(self, state) -> np.ndarray:
+        from .executor import global_scope
+
+        v = global_scope().find_var(state.name)
+        if v is None:
+            raise RuntimeError(
+                f"evaluator state '{state.name}' not initialized — run the "
+                f"startup program (or reset()) first")
+        return np.asarray(v)
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulate chunk counts across batches and report epoch-level
+    precision/recall/F1 (reference evaluator.py:118 over chunk_eval)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        (precision, recall, f1, num_infer, num_label,
+         num_correct) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        # accumulate in float32: the per-batch counts are int64, and the
+        # runtime's int path truncates to int32 — chunk counts fit f32
+        # exactly up to 2^24 per epoch
+        self.num_infer_chunks = self._create_state(
+            "num_infer_chunks", "float32", [1])
+        self.num_label_chunks = self._create_state(
+            "num_label_chunks", "float32", [1])
+        self.num_correct_chunks = self._create_state(
+            "num_correct_chunks", "float32", [1])
+        for state, batch in ((self.num_infer_chunks, num_infer),
+                             (self.num_label_chunks, num_label),
+                             (self.num_correct_chunks, num_correct)):
+            inc = layers.cast(batch, "float32")
+            self.helper.append_op(
+                "elementwise_add", {"X": [state], "Y": [inc]},
+                {"Out": [state]}, {})
+        self.metrics = [precision, recall, f1]
+
+    def eval(self, executor, eval_program=None):
+        infer = float(self._state_value(self.num_infer_chunks)[0])
+        label = float(self._state_value(self.num_label_chunks)[0])
+        correct = float(self._state_value(self.num_correct_chunks)[0])
+        precision = correct / infer if infer else 0.0
+        recall = correct / label if label else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return (np.array([precision], np.float32),
+                np.array([recall], np.float32),
+                np.array([f1], np.float32))
+
+
+class EditDistance(Evaluator):
+    """Accumulate edit distances across batches (reference evaluator.py:189):
+    eval() returns the average distance and the fraction of sequences with
+    at least one error."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__("edit_distance")
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state(
+            "total_distance", "float32", [1])
+        self.seq_num = self._create_state("seq_num", "float32", [1])
+        self.instance_error = self._create_state(
+            "instance_error", "float32", [1])
+        batch_dist = layers.reduce_sum(distances)
+        # distances are >= 0, so sign() is the per-sequence error indicator
+        batch_err = layers.reduce_sum(layers.sign(distances))
+        for state, inc in ((self.total_distance, batch_dist),
+                           (self.seq_num, layers.cast(seq_num, "float32")),
+                           (self.instance_error, batch_err)):
+            self.helper.append_op(
+                "elementwise_add", {"X": [state], "Y": [inc]},
+                {"Out": [state]}, {})
+        self.metrics = [distances, seq_num]
+
+    def eval(self, executor, eval_program=None):
+        total = float(self._state_value(self.total_distance)[0])
+        n = float(self._state_value(self.seq_num)[0])
+        err = float(self._state_value(self.instance_error)[0])
+        avg = total / n if n else 0.0
+        rate = err / n if n else 0.0
+        return (np.array([avg], np.float32), np.array([rate], np.float32))

@@ -54,6 +54,22 @@ def all_flags() -> dict:
 
 
 # -- declarations ------------------------------------------------------------
+_define("conv_implicit_gemm", "auto",
+        "lower eligible conv2d ops as implicit-GEMM im2col matmuls: the "
+        "contraction dim folds C*kh*kw (e.g. 64*9=576 — full 128-lane MXU "
+        "fill where the direct conv contracts K=C=64). 'auto' (default) "
+        "enables per shape where the tile-fill-vs-HBM cost model in "
+        "ops/nn_ops.py predicts a win (narrow-channel convs; the model's "
+        "constants are the measured PERF.md rooflines); 'on' forces every "
+        "groups=1 conv (incl. 1x1-as-matmul) for A/B runs; 'off' disables")
+_define("bn_fuse_stats", True,
+        "fuse conv2d -> batch_norm(training) pairs into one conv2d_bn op at "
+        "minimize() time (passes.fuse_conv_bn_stats): E[x]/E[x2] batch "
+        "statistics are computed in the conv's epilogue from the fp32 GEMM "
+        "accumulator (one pass, fp32 statistics per the AMP gray-list "
+        "discipline) instead of a separate HBM traversal of the conv "
+        "output — the measured 17-35%% BN-stats share of ResNet stage time "
+        "(PERF.md r5)")
 _define("pallas_xent", False,
         "route large-vocab hard-label softmax_with_cross_entropy through "
         "the Pallas TPU kernel (ops/pallas_kernels/xent.py). Default OFF: "

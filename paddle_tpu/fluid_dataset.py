@@ -128,9 +128,19 @@ class DatasetBase:
 
 class QueueDataset(DatasetBase):
     """Streaming dataset (reference dataset.py:613): files are parsed by a
-    thread pool during iteration; nothing is retained afterwards."""
+    thread pool during iteration; nothing is retained afterwards.
+
+    Batch ASSEMBLY (the `_split_batch` slice/reshape/dtype-cast fan-out)
+    runs on the parser workers too, not on the consuming thread: with the
+    device step dispatching asynchronously, the r5 profile put the DeepFM
+    end-to-end path at 0.6-0.7x its pure device throughput, and the
+    assembly work serialized on the consumer was part of that residue
+    (VERDICT r5 #3). Workers hand the executor feed-ready dicts, so the
+    consumer thread's epoch loop is queue-pop -> dispatch."""
 
     def _iter_batches(self):
+        from . import flags, profiler
+
         self._prepare_to_run()
         files = queue.Queue()
         for f in self.filelist:
@@ -161,7 +171,17 @@ class QueueDataset(DatasetBase):
                         chunk = data[i:i + self.batch_size]
                         if self.drop_last and len(chunk) < self.batch_size:
                             continue
-                        if not _put(chunk):
+                        try:
+                            feed = self._split_batch(chunk)
+                        except (ValueError, TypeError):
+                            # corrupt record died in assembly, off-thread:
+                            # same skip-and-count contract as the executor's
+                            # own conversion site
+                            if not flags.get_flag("feed_skip_corrupt"):
+                                raise
+                            profiler.bump("feed.skip_corrupt")
+                            continue
+                        if not _put(feed):
                             return
             except BaseException as e:  # propagate into the consumer
                 errors.append(e)
@@ -179,7 +199,7 @@ class QueueDataset(DatasetBase):
                 if item is None:
                     finished += 1
                     continue
-                yield self._split_batch(item)
+                yield item  # already assembled by the worker
         finally:
             # early exit (exe.run raised / caller broke out): unblock workers
             stop.set()
@@ -282,15 +302,55 @@ class InMemoryDataset(DatasetBase):
     get_shuffle_data_size = get_memory_data_size
 
     def _iter_batches(self):
+        """Assembly double-buffers ahead of the consumer (the pyreader.py
+        pattern): one background thread slices/reshapes/casts the next
+        batches while the device chews on the current one, bounded at
+        depth 2 so a slow consumer doesn't balloon host memory."""
         self._prepare_to_run()
         if self._data is None:
             raise RuntimeError(
                 "InMemoryDataset: call load_into_memory() before training")
-        for i in range(0, len(self._data), self.batch_size):
-            chunk = self._data[i:i + self.batch_size]
-            if self.drop_last and len(chunk) < self.batch_size:
-                continue
-            yield self._split_batch(chunk)
+        out: queue.Queue = queue.Queue(maxsize=2)
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def assembler():
+            try:
+                for i in range(0, len(self._data), self.batch_size):
+                    chunk = self._data[i:i + self.batch_size]
+                    if self.drop_last and len(chunk) < self.batch_size:
+                        continue
+                    feed = self._split_batch(chunk)
+                    while not stop.is_set():
+                        try:
+                            out.put(feed, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                while not stop.is_set():
+                    try:
+                        out.put(None, timeout=0.2)
+                        return
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=assembler, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = out.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            stop.set()
+        if errors:
+            raise errors[0]
 
 
 MultiSlotDataset = QueueDataset

@@ -24,20 +24,145 @@ def _pair(v):
     return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
 
 
-@register_op("conv2d")
-def conv2d(ctx: ExecContext):
-    x, w = ctx.input("Input"), ctx.input("Filter")
-    strides = _pair(ctx.attr("strides", [1, 1]))
-    praw = ctx.attr("paddings", [0, 0])
+def _conv_pads(praw):
     # 2-element [ph, pw] (symmetric) or 4-element [top, bottom, left, right]
     # (asymmetric — needed e.g. by the space-to-depth ResNet stem; an
     # explicit pad op in front of the conv measures 2.4x slower on TPU v5e
     # because XLA does not fold it into the convolution).
     if isinstance(praw, (list, tuple)) and len(praw) == 4:
-        pads = [(praw[0], praw[1]), (praw[2], praw[3])]
+        return [(praw[0], praw[1]), (praw[2], praw[3])]
+    p = _pair(praw)
+    return [(p[0], p[0]), (p[1], p[1])]
+
+
+# Implicit-GEMM cost-model constants — the measured single-chip rooflines
+# this repo's perf campaign is calibrated against (PERF.md r4: matmul
+# 157-162 TF/s sustained, HBM 476-522 GB/s; conv MXU efficiency ~0.7-0.75 of
+# the matmul ceiling at >=half lane fill). The model only has to rank two
+# lowerings of the SAME conv, so absolute calibration error mostly cancels;
+# tools/_rn_igemm.py is the end-to-end A/B that checks it per shape.
+_IGEMM_MXU_FLOPS = 157e12
+_IGEMM_HBM_BPS = 450e9
+_IGEMM_MXU_EFF = 0.75
+_IGEMM_WIN_MARGIN = 0.9  # predicted igemm time must beat direct by >=10%
+
+
+def _igemm_predict_win(n, hout, wout, cin, cout, kh, kw, itemsize) -> bool:
+    """Tile-fill vs HBM-traffic model (PAPERS.md: A Learned Performance Model
+    for TPUs, 2008.01040 — the fill term; TVM, 1802.04799 — the layout-
+    rewrite framing): direct conv contracts K=C_in per tap (under-filling
+    the 128-lane MXU when C_in < 128), implicit GEMM folds K=C_in*kh*kw but
+    must materialize the kh*kw-times-larger patch tensor through HBM."""
+    m = n * hout * wout
+    k_fold = cin * kh * kw
+    flops = 2.0 * m * k_fold * cout
+
+    def fill(k):
+        return min(1.0, k / 128.0)
+
+    t_direct = flops / (_IGEMM_MXU_FLOPS * fill(cin) * _IGEMM_MXU_EFF)
+    patch_bytes = 2.0 * m * k_fold * itemsize  # write at im2col + read at dot
+    t_igemm = (flops / (_IGEMM_MXU_FLOPS * fill(k_fold) * _IGEMM_MXU_EFF)
+               + patch_bytes / _IGEMM_HBM_BPS)
+    return t_igemm < _IGEMM_WIN_MARGIN * t_direct
+
+
+def _igemm_mode() -> str:
+    mode = str(flags.get_flag("conv_implicit_gemm")).lower()
+    if mode in ("on", "always", "all", "1", "true"):
+        return "on"
+    if mode in ("off", "never", "0", "false"):
+        return "off"
+    return "auto"
+
+
+def _igemm_take(x, w, strides, pads, d, groups, fmt) -> bool:
+    """Per-shape gate for the implicit-GEMM lowering."""
+    mode = _igemm_mode()
+    if mode == "off" or groups != 1:
+        return False
+    if not (jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.issubdtype(w.dtype, jnp.floating)):
+        return False
+    if fmt == "NCHW":
+        n, cin, h, wi = x.shape
+        kh, kw = w.shape[2], w.shape[3]
     else:
-        p = _pair(praw)
-        pads = [(p[0], p[0]), (p[1], p[1])]
+        n, h, wi, cin = x.shape
+        kh, kw = w.shape[0], w.shape[1]
+    (pt, pb), (pl, pr) = pads
+    hout = (h + pt + pb - ((kh - 1) * d[0] + 1)) // strides[0] + 1
+    wout = (wi + pl + pr - ((kw - 1) * d[1] + 1)) // strides[1] + 1
+    if hout <= 0 or wout <= 0:
+        return False
+    if mode == "on":
+        return True
+    cout = w.shape[0] if fmt == "NCHW" else w.shape[3]
+    return _igemm_predict_win(n, hout, wout, cin, cout, kh, kw,
+                              jnp.dtype(x.dtype).itemsize)
+
+
+def _conv2d_igemm_f32(x, w, strides, pads, d, fmt):
+    """im2col + GEMM lowering, returning the fp32 accumulator [*, C_out]
+    in the output layout. The kh*kw shifted strided slices of the padded
+    input concatenate tap-major along the channel dim, matching a plain
+    reshape of the HWIO (NHWC) / tap-major-transposed OIHW (NCHW) filter —
+    so one lax.dot_general carries the whole conv with K = C_in*kh*kw.
+    Backward derives via vjp: dX is the transposed GEMM scattered by the
+    slice transposes (col2im), dW the patches^T @ dOut GEMM — both ride the
+    MXU at the same folded fill."""
+    sh, sw = strides
+    dh, dw = d
+    if fmt == "NCHW":
+        n, cin, h, wi = x.shape
+        cout, _, kh, kw = w.shape
+        xp = jnp.pad(x, ((0, 0), (0, 0), pads[0], pads[1]))
+        hout = (h + sum(pads[0]) - ((kh - 1) * dh + 1)) // sh + 1
+        wout = (wi + sum(pads[1]) - ((kw - 1) * dw + 1)) // sw + 1
+        taps = [
+            jax.lax.slice(
+                xp,
+                (0, 0, i * dh, j * dw),
+                (n, cin, i * dh + (hout - 1) * sh + 1,
+                 j * dw + (wout - 1) * sw + 1),
+                (1, 1, sh, sw))
+            for i in range(kh) for j in range(kw)
+        ]
+        patches = jnp.concatenate(taps, axis=1)  # [N, kh*kw*Cin, H', W']
+        wmat = jnp.transpose(w, (2, 3, 1, 0)).reshape(kh * kw * cin, cout)
+        acc = jax.lax.dot_general(
+            patches, wmat, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [N, H', W', Cout]
+        return jnp.transpose(acc, (0, 3, 1, 2))
+    n, h, wi, cin = x.shape
+    kh, kw, _, cout = w.shape
+    xp = jnp.pad(x, ((0, 0), pads[0], pads[1], (0, 0)))
+    hout = (h + sum(pads[0]) - ((kh - 1) * dh + 1)) // sh + 1
+    wout = (wi + sum(pads[1]) - ((kw - 1) * dw + 1)) // sw + 1
+    taps = [
+        jax.lax.slice(
+            xp,
+            (0, i * dh, j * dw, 0),
+            (n, i * dh + (hout - 1) * sh + 1,
+             j * dw + (wout - 1) * sw + 1, cin),
+            (1, sh, sw, 1))
+        for i in range(kh) for j in range(kw)
+    ]
+    patches = jnp.concatenate(taps, axis=-1)  # [N, H', W', kh*kw*Cin]
+    wmat = w.reshape(kh * kw * cin, cout)
+    return jax.lax.dot_general(
+        patches.reshape(n * hout * wout, kh * kw * cin), wmat,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).reshape(n, hout, wout, cout)
+
+
+def _conv2d_forward(ctx: ExecContext):
+    """Shared conv lowering: returns (out_in_x_dtype, fp32_acc_or_None).
+    The fp32 accumulator is only materialized on the implicit-GEMM path
+    (the dot's natural output); conv2d_bn reads it for epilogue statistics."""
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _conv_pads(ctx.attr("paddings", [0, 0]))
     d = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1)
     # data_format NHWC keeps the whole activation chain channels-last on
@@ -46,6 +171,9 @@ def conv2d(ctx: ExecContext):
     # into an NHWC conv measure ~25-40% slower (XLA picks a worse
     # algorithm) and an in-step transpose still costs ~6%/conv (PERF r5).
     fmt = ctx.attr("data_format", "NCHW")
+    if _igemm_take(x, w, strides, pads, d, groups, fmt):
+        acc = _conv2d_igemm_f32(x, w, strides, pads, d, fmt)
+        return acc.astype(x.dtype), acc
     rhs = "OIHW" if fmt == "NCHW" else "HWIO"
     # No preferred_element_type=f32 + astype pair here: the TPU MXU already
     # accumulates bf16 convs in fp32 internally, and the astype's transpose
@@ -61,6 +189,12 @@ def conv2d(ctx: ExecContext):
         dimension_numbers=(fmt, rhs, fmt),
         feature_group_count=groups,
     )
+    return out, None
+
+
+@register_op("conv2d")
+def conv2d(ctx: ExecContext):
+    out, _ = _conv2d_forward(ctx)
     return {"Output": out}
 
 
@@ -342,6 +476,52 @@ def batch_norm(ctx: ExecContext):
         "VarianceOut": var_out,
         "SavedMean": saved_mean,
         "SavedVariance": saved_var,
+    }
+
+
+@register_op("conv2d_bn", stateful_outputs=("MeanOut", "VarianceOut"))
+def conv2d_bn(ctx: ExecContext):
+    """Fused conv2d -> batch_norm(training) with one-pass epilogue
+    statistics (passes.fuse_conv_bn_stats rewrites eligible pairs to this).
+
+    The separate batch_norm op re-reads the conv output from HBM to reduce
+    E[x]/E[x^2] — measured at 17-35% of ResNet stage time (PERF.md r5,
+    tools/_rn_diag.py). Here both statistics are computed as siblings of the
+    conv's own result — on the implicit-GEMM path directly from the fp32 GEMM
+    accumulator before the bf16 down-cast — so XLA's multi-output fusion can
+    emit them in the producer's epilogue while the tile is still on-chip,
+    instead of a second HBM traversal. Statistics stay fp32 regardless of the
+    activation dtype (the AMP gray-list discipline; bf16 in/out is safe
+    because nothing below fp32 ever carries a running statistic)."""
+    out, acc = _conv2d_forward(ctx)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    fmt = ctx.attr("data_format", "NCHW")
+    cax = 1 if fmt == "NCHW" else out.ndim - 1
+    axes = tuple(i for i in range(out.ndim) if i != cax)
+    bshape = [1] * out.ndim
+    bshape[cax] = -1
+
+    # one-pass statistics from the highest-precision view available: the
+    # implicit-GEMM fp32 accumulator when the conv took that path (exact
+    # pre-rounding moments), else an fp32 upcast of the conv result (the
+    # same values batch_norm would see, now adjacent to the producer)
+    xf = acc if acc is not None else out.astype(jnp.float32)
+    use_mean = jnp.mean(xf, axis=axes)
+    use_var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(use_mean)
+    mean_out = mean * momentum + use_mean.astype(mean.dtype) * (1 - momentum)
+    var_out = var * momentum + use_var.astype(var.dtype) * (1 - momentum)
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y.astype(out.dtype),
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": use_mean.astype(mean.dtype),
+        "SavedVariance": (1.0 / jnp.sqrt(use_var + eps)).astype(var.dtype),
     }
 
 

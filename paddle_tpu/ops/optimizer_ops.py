@@ -413,6 +413,14 @@ def dgc(ctx: ExecContext):
     mask = |v| >= thr; GradOut = v*mask; v *= ~mask; u *= ~mask.
     GradOut is what rides the allreduce — fixed-shape but mostly zeros,
     which is the XLA-friendly equivalent of the reference's sparse send.
+
+    With a CurrentStep input the per-step sparsity follows the reference
+    warmup schedule (optimizer.py:805 get_sparsity) IN-GRAPH: 0 before
+    rampup_begin_step (threshold at the min -> everything released = plain
+    momentum through the error-feedback identity), then the sparsity_ramp
+    list section-by-section across rampup_step steps, holding its last
+    value. The quantile's q is a traced scalar, so one compiled step serves
+    the whole schedule.
     """
     import jax.numpy as _jnp
 
@@ -420,8 +428,23 @@ def dgc(ctx: ExecContext):
     u = ctx.input("U")
     v = ctx.input("V")
     m = float(ctx.attr("momentum", 0.9))
-    sparsity = float(ctx.attr("sparsity", 0.999))
     use_nesterov = bool(ctx.attr("use_nesterov", False))
+    step = ctx.input("CurrentStep")
+    if step is not None:
+        ramp = [float(s) for s in
+                (ctx.attr("sparsity_ramp", None)
+                 or [ctx.attr("sparsity", 0.999)])]
+        begin = float(ctx.attr("rampup_begin_step", 0))
+        width = float(max(1, ctx.attr("rampup_step", 1)))
+        s = step.reshape(()).astype(_jnp.float32)
+        rel = s - begin
+        idx = _jnp.clip(_jnp.floor(rel * len(ramp) / width),
+                        0, len(ramp) - 1).astype(_jnp.int32)
+        sparsity = _jnp.where(rel < 0, 0.0,
+                              _jnp.asarray(ramp, _jnp.float32)[idx])
+    else:
+        sparsity = _jnp.asarray(float(ctx.attr("sparsity", 0.999)),
+                                _jnp.float32)
     u = m * u + g
     if use_nesterov:
         v = v + (g + m * u)
@@ -433,7 +456,8 @@ def dgc(ctx: ExecContext):
     grad_out = _jnp.where(mask, v, 0)
     v = _jnp.where(mask, 0, v)
     u = _jnp.where(mask, 0, u)
-    return {"GradOut": grad_out, "UOut": u, "VOut": v}
+    return {"GradOut": grad_out, "UOut": u, "VOut": v,
+            "Sparsity": sparsity.reshape(1)}
 
 
 @register_op("model_average_accum", grad="none",

@@ -123,6 +123,12 @@ class Optimizer:
         # var to build its in-graph health vector; record it on the program
         # (the AMP decorator overwrites this with the UNSCALED loss)
         default_main_program()._guard_loss_name = loss.name
+        # graph rewrites that must precede append_backward (fused ops derive
+        # their gradients via vjp over the fused lowering) and follow any AMP
+        # rewrite (AMP's decorator calls into this backward after its own)
+        from .passes import apply_minimize_passes
+
+        apply_minimize_passes(default_main_program())
         return append_backward(loss, parameter_list, no_grad_set)
 
     def apply_gradients(self, params_grads):
@@ -310,10 +316,15 @@ class DGCMomentumOptimizer(MomentumOptimizer):
     rides on the mostly-zero GradOut — the fixed-shape TPU equivalent of the
     reference's sparse communication.
 
-    rampup_begin_step/rampup_step/sparsity keep the reference signature; the
-    TPU build uses the final sparsity from step one (the rampup schedule is a
-    host-side curriculum the static graph cannot branch on cheaply, noted
-    here for parity).
+    The warmup rampup (reference __append_dgc_ops' get_sparsity schedule)
+    is computed IN-GRAPH from a per-step counter — the same plumbing the LR
+    schedules use (layers/learning_rate_scheduler.py): before
+    rampup_begin_step sparsity is 0 (every gradient released = plain
+    momentum via the error-feedback identity), then it steps through the
+    `sparsity` list across rampup_step steps and holds the final value.
+    Every dgc op also emits its effective per-step sparsity as a fetchable
+    `...dgc_sparsity` var (the oracle tests/test_losses_and_quant.py
+    follows).
     """
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
@@ -323,8 +334,30 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         super().__init__(learning_rate, momentum, use_nesterov,
                          regularization, name)
         self.type = "dgc_momentum"
-        self._sparsity = float(sparsity[-1] if isinstance(
-            sparsity, (list, tuple)) else sparsity)
+        sp = (list(sparsity) if isinstance(sparsity, (list, tuple))
+              else [sparsity])
+        self._sparsity_ramp = [float(s) for s in sp]
+        self._sparsity = self._sparsity_ramp[-1]
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+
+    def _dgc_step_counter(self):
+        """Per-program float32 step counter incremented once per executor
+        run, shared by every dgc op in the program (the LR schedulers'
+        @LR_DECAY_COUNTER@ pattern with a private name, so a noam_decay
+        schedule with a different counter origin can coexist)."""
+        helper = LayerHelper("dgc_counter")
+        program = default_main_program()
+        name = "@DGC_COUNTER@"
+        existed = name in program.global_block.vars
+        counter = helper.create_or_get_global_variable(
+            name, [1], "float32", initializer=Constant(-1.0))
+        if not existed:
+            # the increment precedes every dgc op in program order, so the
+            # first executed step reads 0
+            helper.append_op("increment", {"X": [counter]},
+                             {"Out": [counter]}, {"step": 1.0})
+        return counter
 
     def _create_accumulators(self, block, parameters):
         # no inherited velocity: momentum lives in dgc_u (the dgc op's
@@ -342,15 +375,23 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         param, grad = param_and_grad
         u = self._get_accumulator("dgc_u", param)
         v = self._get_accumulator("dgc_v", param)
+        step = self._dgc_step_counter()
         helper = LayerHelper("dgc")
         sparse_grad = helper.create_variable_for_type_inference(grad.dtype)
+        cur_sparsity = helper.create_or_get_global_variable(
+            unique_name.generate(f"{param.name}_dgc_sparsity"), [1],
+            "float32", initializer=Constant(0.0))
         block.append_op(
             "dgc",
-            inputs={"Grad": [grad.name], "U": [u.name], "V": [v.name]},
+            inputs={"Grad": [grad.name], "U": [u.name], "V": [v.name],
+                    "CurrentStep": [step.name]},
             outputs={"GradOut": [sparse_grad.name], "UOut": [u.name],
-                     "VOut": [v.name]},
+                     "VOut": [v.name], "Sparsity": [cur_sparsity.name]},
             attrs={"momentum": self._momentum,
                    "sparsity": self._sparsity,
+                   "sparsity_ramp": self._sparsity_ramp,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "rampup_step": self._rampup_step,
                    "use_nesterov": self._use_nesterov},
         )
         # momentum is already folded into U by the dgc op (momentum

@@ -1,0 +1,135 @@
+"""Whole-program graph rewrites that change the HLO XLA sees.
+
+Most reference IR passes (~45 of them) are subsumed by XLA fusion and need no
+analogue here (SURVEY §7). The passes in this module exist because they alter
+the *structure* XLA optimizes — value provenance and op adjacency — which
+fusion alone cannot recover:
+
+  * fuse_conv_bn_stats: conv2d -> batch_norm(training) pairs become one
+    conv2d_bn op whose batch statistics are computed in the conv's epilogue
+    (on the implicit-GEMM path: from the fp32 GEMM accumulator before the
+    low-precision down-cast). The standalone batch_norm reads the conv
+    output back from HBM for its E[x]/E[x^2] reductions — measured at
+    17-35% of ResNet-50 stage time (PERF.md r5, tools/_rn_diag.py).
+
+Runs at minimize() time, before append_backward (the fused op's gradient
+derives via vjp over the fused lowering) and after any AMP rewrite (so the
+pattern sees final dtypes; AMP's casts between the pair target BN's
+Scale/Bias side inputs, never the conv->BN activation edge).
+"""
+from __future__ import annotations
+
+from . import flags
+
+__all__ = ["fuse_conv_bn_stats", "apply_minimize_passes"]
+
+
+def _writes(op, name: str) -> bool:
+    return any(name in ns for ns in op.outputs.values())
+
+
+def _reads(op, name: str) -> bool:
+    return any(name in ns for ns in op.inputs.values())
+
+
+def _match_bn_consumer(block, conv_idx: int, out_name: str):
+    """Index of the single batch_norm(training) consuming `out_name`, or None.
+
+    Requirements for a semantics-preserving merge:
+      * out_name has exactly one reader in the block and none elsewhere in
+        the program (it disappears from the graph);
+      * that reader is a training-mode batch_norm whose layout matches the
+        conv's data_format;
+      * no op between producer and consumer redefines the conv's inputs or
+        touches out_name (the conv's computation is moved to the BN's
+        position).
+    """
+    conv = block.ops[conv_idx]
+    readers = []
+    for b in block.program.blocks:
+        for i, op in enumerate(b.ops):
+            if op is not conv and _reads(op, out_name):
+                readers.append((b, i, op))
+            if op is not conv and _writes(op, out_name):
+                return None
+    if len(readers) != 1:
+        return None
+    b, bn_idx, bn = readers[0]
+    if b is not block or bn_idx <= conv_idx or bn.type != "batch_norm":
+        return None
+    if bn.input("X") != [out_name]:
+        return None
+    if bn.attr("is_test", False):
+        return None  # inference BN has no statistics pass to fuse
+    if bn.attr("data_layout", "NCHW") != conv.attr("data_format", "NCHW"):
+        return None
+    moved = set(conv.input("Input") + conv.input("Filter"))
+    for mid in block.ops[conv_idx + 1:bn_idx]:
+        if any(_writes(mid, n) for n in moved):
+            return None
+    return bn_idx
+
+
+def fuse_conv_bn_stats(program) -> int:
+    """Rewrite every eligible conv2d -> batch_norm(training) pair into one
+    conv2d_bn op (ops/nn_ops.py). Returns the number of pairs fused. The
+    orphaned conv-output var stays declared in the block (harmless; it no
+    longer has a producer, like any pruned intermediate)."""
+    n_fused = 0
+    for block in program.blocks:
+        i = 0
+        while i < len(block.ops):
+            conv = block.ops[i]
+            if conv.type != "conv2d":
+                i += 1
+                continue
+            out_name = conv.output("Output")[0]
+            bn_idx = _match_bn_consumer(block, i, out_name)
+            if bn_idx is None:
+                i += 1
+                continue
+            bn = block.ops[bn_idx]
+            inputs = {
+                "Input": conv.input("Input"),
+                "Filter": conv.input("Filter"),
+                "Scale": bn.input("Scale"),
+                "Bias": bn.input("Bias"),
+                "Mean": bn.input("Mean"),
+                "Variance": bn.input("Variance"),
+            }
+            outputs = {
+                "Y": bn.output("Y"),
+                "MeanOut": bn.output("MeanOut"),
+                "VarianceOut": bn.output("VarianceOut"),
+                "SavedMean": bn.output("SavedMean"),
+                "SavedVariance": bn.output("SavedVariance"),
+            }
+            attrs = {
+                "strides": conv.attr("strides", [1, 1]),
+                "paddings": conv.attr("paddings", [0, 0]),
+                "dilations": conv.attr("dilations", [1, 1]),
+                "groups": conv.attr("groups", 1),
+                "data_format": conv.attr("data_format", "NCHW"),
+                "epsilon": bn.attr("epsilon", 1e-5),
+                "momentum": bn.attr("momentum", 0.9),
+            }
+            # replace the BN in place (every fused input's producer precedes
+            # it), then drop the conv
+            del block.ops[bn_idx]
+            block._insert_op(bn_idx, "conv2d_bn", inputs, outputs, attrs)
+            del block.ops[i]
+            n_fused += 1
+            # stay at i: the next op shifted into this slot
+    if n_fused:
+        program._bump_version()
+    return n_fused
+
+
+def apply_minimize_passes(program) -> None:
+    """Flag-gated pass pipeline run once per minimize()/backward() on the
+    main program (optimizer.Optimizer.backward — the single choke point both
+    the plain and the AMP-decorated paths flow through)."""
+    if flags.get_flag("bn_fuse_stats") and not getattr(
+            program, "_bn_stats_fused", False):
+        program._bn_stats_fused = True  # idempotent across re-entry
+        fuse_conv_bn_stats(program)

@@ -148,3 +148,96 @@ def test_global_shuffle_partitions_by_rank(tmp_path):
     union = np.concatenate(seen)
     np.testing.assert_allclose(
         np.sort(union.ravel()), np.sort(ds_full._data.ravel()))
+
+
+def test_queue_dataset_assembly_runs_on_worker_threads(tmp_path):
+    """ISSUE 5 satellite (VERDICT r5 #3): batch ASSEMBLY (_split_batch) must
+    run on the parser workers, overlapped with the consumer's dispatch loop,
+    and the generator must yield feed-ready dicts."""
+    import threading
+
+    files = _write_ctr_files(tmp_path)
+    ids, dense, label, _ = _build_ctr()
+    ds = pt.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_thread(2)
+    ds.set_use_var([ids, dense, label])
+    ds.set_filelist(files)
+
+    assembly_threads = set()
+    orig = ds._split_batch
+
+    def spying_split(flat):
+        assembly_threads.add(threading.get_ident())
+        return orig(flat)
+
+    ds._split_batch = spying_split
+    batches = list(ds._iter_batches())
+    assert batches and all(isinstance(b, dict) for b in batches)
+    assert set(batches[0]) == {ids.name, dense.name, label.name}
+    assert threading.get_ident() not in assembly_threads
+    assert assembly_threads  # the workers actually assembled
+
+
+def test_inmemory_dataset_double_buffers_assembly(tmp_path):
+    import threading
+
+    files = _write_ctr_files(tmp_path)
+    ids, dense, label, _ = _build_ctr()
+    ds = pt.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_use_var([ids, dense, label])
+    ds.set_filelist(files)
+    ds.load_into_memory()
+
+    assembly_threads = set()
+    orig = ds._split_batch
+
+    def spying_split(flat):
+        assembly_threads.add(threading.get_ident())
+        return orig(flat)
+
+    ds._split_batch = spying_split
+    n = sum(1 for _ in ds._iter_batches())
+    assert n == 10  # 80 rows / batch 8
+    assert threading.get_ident() not in assembly_threads
+
+
+def test_queue_dataset_worker_skips_corrupt_batch(tmp_path):
+    """A batch whose assembly raises dies OFF-thread now: under
+    FLAGS_feed_skip_corrupt it must be counted and skipped, not kill the
+    epoch; without the flag the error must still surface to the consumer."""
+    from paddle_tpu import flags, profiler
+
+    files = _write_ctr_files(tmp_path, n_files=1, lines_per_file=24)
+    ids, dense, label, _ = _build_ctr()
+
+    def make_ds():
+        ds = pt.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(8)
+        ds.set_use_var([ids, dense, label])
+        ds.set_filelist(files)
+        orig = ds._split_batch
+        calls = {"n": 0}
+
+        def poisoned(flat):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise ValueError("corrupt batch (injected)")
+            return orig(flat)
+
+        ds._split_batch = poisoned
+        return ds
+
+    saved = flags.get_flag("feed_skip_corrupt")
+    try:
+        flags.set_flags({"feed_skip_corrupt": True})
+        profiler.stage_counters(reset=True)
+        got = list(make_ds()._iter_batches())
+        assert len(got) == 2  # 3 batches, one poisoned
+        assert profiler.stage_counters()["feed.skip_corrupt"]["events"] == 1
+        flags.set_flags({"feed_skip_corrupt": False})
+        with pytest.raises(ValueError, match="corrupt batch"):
+            list(make_ds()._iter_batches())
+    finally:
+        flags.set_flags({"feed_skip_corrupt": saved})

@@ -1,0 +1,116 @@
+"""Dygraph zoo completion (VERDICT r5 #2 / ISSUE 5 satellite): `FC` (the
+lazy-weight, num_flatten_dims eager dense layer, reference dygraph/nn.py:773)
+and `Conv2DTranspose` (reference dygraph/nn.py:1964) as tape Layers, each
+checked against the static-graph layer with the same parameters, plus
+gradient flow through the tape."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph as dg
+from paddle_tpu import layers as L
+from paddle_tpu.dygraph import _dy_op
+
+
+def _static_eval(build_fn, feeds, params_by_shape):
+    """Run a static program, injecting params positionally by shape (the
+    test_dygraph_layers_r5 oracle helper)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        with pt.unique_name.guard():
+            out = build_fn()
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        remaining = list(params_by_shape)
+        for p in main.all_parameters():
+            for i, v in enumerate(remaining):
+                if tuple(v.shape) == tuple(p.shape):
+                    pt.global_scope().set_var(p.name, v)
+                    remaining.pop(i)
+                    break
+            else:
+                raise AssertionError(
+                    f"no injected value of shape {p.shape} for {p.name}")
+        assert not remaining, [v.shape for v in remaining]
+        return np.asarray(exe.run(main, feed=feeds, fetch_list=[out])[0])
+
+
+def test_dygraph_fc_lazy_weight_matches_static():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 2, 3, 5)).astype(np.float32)
+    with dg.guard():
+        layer = dg.FC(size=7, num_flatten_dims=2, act="relu")
+        assert layer.weight is None  # lazy until the first forward
+        got = layer(dg.to_variable(x)).numpy()
+        # weight materialized from the trailing dims: [3*5, 7]
+        assert tuple(layer.weight.shape) == (15, 7)
+        w, b = layer.weight.numpy(), layer.bias.numpy()
+        # second call reuses the same parameter (no re-create)
+        again = layer(dg.to_variable(x)).numpy()
+    np.testing.assert_allclose(again, got, rtol=1e-6)
+    assert got.shape == (4, 2, 7)
+
+    def build():
+        xv = L.data(name="x", shape=[2, 3, 5], dtype="float32")
+        return L.fc(xv, size=7, num_flatten_dims=2, act="relu")
+
+    ref = _static_eval(build, {"x": x}, [w, b])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dygraph_fc_gradient_flows():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((8, 6)).astype(np.float32)
+    with dg.guard():
+        layer = dg.FC(size=3)
+        out = layer(dg.to_variable(x))
+        loss = _dy_op("mean", {"X": [out]})["Out"]
+        loss.backward()
+        g = layer.weight.gradient()
+    assert g is not None and g.shape == (6, 3)
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_dygraph_conv2d_transpose_matches_static():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3, 5, 5)).astype(np.float32)
+    with dg.guard():
+        layer = dg.Conv2DTranspose(num_channels=3, num_filters=4,
+                                   filter_size=3, stride=2, padding=1)
+        got = layer(dg.to_variable(x)).numpy()
+        w, b = layer.weight.numpy(), layer.bias.numpy()
+    assert tuple(w.shape) == (3, 4, 3, 3)
+
+    def build():
+        xv = L.data(name="x", shape=[3, 5, 5], dtype="float32")
+        return L.conv2d_transpose(xv, num_filters=4, filter_size=3,
+                                  stride=2, padding=1)
+
+    ref = _static_eval(build, {"x": x}, [w, b])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dygraph_conv2d_transpose_gradient_and_act():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+    with dg.guard():
+        layer = dg.Conv2DTranspose(num_channels=3, num_filters=2,
+                                   filter_size=2, stride=2, act="relu")
+        out = layer(dg.to_variable(x))
+        assert out.shape == (2, 2, 8, 8)
+        assert (out.numpy() >= 0).all()  # act applied
+        _dy_op("mean", {"X": [out]})["Out"].backward()
+        g = layer.weight.gradient()
+    assert g is not None and np.isfinite(np.asarray(g)).all()
+
+
+def test_dygraph_zoo_superset_of_reference_nn():
+    """The reference dygraph/nn.py class list is now a subset of ours."""
+    reference_zoo = {
+        "Conv2D", "Conv3D", "Pool2D", "FC", "BatchNorm", "Embedding",
+        "LayerNorm", "GRUUnit", "NCE", "PRelu", "BilinearTensorProduct",
+        "Conv2DTranspose", "Conv3DTranspose", "GroupNorm", "SpectralNorm",
+        "TreeConv",
+    }
+    missing = reference_zoo - set(dir(dg))
+    assert not missing, missing

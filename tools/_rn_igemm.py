@@ -1,0 +1,58 @@
+"""ResNet-50 conv-lever A/B: implicit-GEMM lowering x fused one-pass BN stats.
+
+END-TO-END ONLY, per the r5 methodology: chained per-op microbenches are
+twice-proven poisoned on this stack (the r3 "conv ceiling" artifact and the
+r5 xent harness-pollution finding, PERF.md) — every arm here is a full
+framework train step timed with bench.py's own protocol (async dispatch,
+drain-synchronized windows, best-of-N).
+
+Arms:
+    off   : direct conv + two-pass batch_norm (the r5 bench configuration)
+    auto  : FLAGS_conv_implicit_gemm=auto (per-shape cost model) + fused BN
+    igemm : implicit GEMM forced ON for every conv, two-pass BN (isolates
+            the im2col lowering, including shapes the cost model rejects)
+    bnfuse: direct conv + fused one-pass BN statistics (isolates the pass)
+
+Run on the chip:  python tools/_rn_igemm.py [--iters 50]
+Prints one JSON line per arm plus a summary; feed the numbers to PERF.md r6.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+from paddle_tpu import flags  # noqa: E402
+
+ARMS = {
+    "off": ("off", False),
+    "auto": ("auto", True),
+    "igemm": ("on", False),
+    "bnfuse": ("off", True),
+}
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    peak = bench._peak_flops(jax.devices()[0])
+    results = {}
+    for name, (igemm, fuse) in ARMS.items():
+        flags.set_flags({"conv_implicit_gemm": igemm, "bn_fuse_stats": fuse})
+        img_s, mfu, windows = bench._resnet_arm(on_tpu, peak)
+        results[name] = {"img_s": round(img_s, 1), "mfu": round(mfu, 4),
+                         "windows_img_s": windows}
+        print(json.dumps({"arm": name, **results[name]}), flush=True)
+    base = results["off"]["img_s"]
+    print(json.dumps({
+        "summary": {k: round(v["img_s"] / base, 4) for k, v in results.items()},
+        "note": "ratios vs the 'off' arm; >1.0 = lever wins end-to-end",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

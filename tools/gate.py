@@ -33,6 +33,12 @@ DEEPFM_RATIO_FLOOR = 0.9
 # this, the guard itself is the perf bug (ISSUE 4 acceptance line)
 GUARD_OVERHEAD_CEIL_PCT = 2.0
 
+# ResNet-50 is the round-6 campaign metric (ISSUE 5): flag any artifact whose
+# resnet50 vs_target falls more than the interference band below the previous
+# round's — a conv-lowering/BN regression, not box noise (single bursts move
+# one window, not the best-of-3 protocol, PERF.md r4/r5)
+RESNET_VS_TARGET_DROP = 0.95
+
 
 def run_suite() -> int:
     print("[gate] running test suite ...", flush=True)
@@ -92,16 +98,49 @@ def _bench_metrics(text: str) -> dict | None:
     return None
 
 
+def _check_resnet_regression(data: dict, prev_path: str | None,
+                             label: str) -> int:
+    """Fail when the newest artifact's `resnet50` vs_target dropped more
+    than the interference band below the previous artifact's (ISSUE 5 round
+    6). Artifacts without the per-workload vs_target dict are skipped."""
+    cur = (data.get("vs_target") or {}).get("resnet50")
+    if cur is None or prev_path is None:
+        return 0
+    try:
+        with open(prev_path) as f:
+            prev = _bench_metrics(f.read())
+    except (OSError, ValueError):
+        return 0
+    prev_v = ((prev or {}).get("vs_target") or {}).get("resnet50")
+    if prev_v is None:
+        return 0
+    ab = data.get("resnet50_lever_ab")
+    print(f"[gate] bench {label}: resnet50 vs_target {cur} "
+          f"(prev {prev_v}{', lever A/B ' + str(ab) if ab else ''})",
+          flush=True)
+    if cur < RESNET_VS_TARGET_DROP * prev_v:
+        print(f"[gate] FAIL: resnet50 vs_target regressed {prev_v} -> {cur} "
+              f"(> {100 * (1 - RESNET_VS_TARGET_DROP):.0f}% drop) — check "
+              f"resnet50_lever_ab and resnet50_windows_img_s for which arm "
+              f"moved before blaming the conv lowering", flush=True)
+        return 1
+    return 0
+
+
 def check_bench(path: str | None = None) -> int:
     """Flag a DeepFM end-to-end/device-path regression in the bench artifact.
 
     Pre-pipeline artifacts (no deepfm_e2e_device_ratio field) are skipped so
     the gate stays meaningful across old snapshots."""
+    prev_path = None
+    arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
     if path is None:
-        arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
         if not arts:
             return 0
         path = arts[-1]
+    apath = os.path.abspath(path)
+    if apath in arts and arts.index(apath) > 0:
+        prev_path = arts[arts.index(apath) - 1]
     try:
         with open(path) as f:
             text = f.read()
@@ -113,6 +152,8 @@ def check_bench(path: str | None = None) -> int:
     if data is None:
         print(f"[gate] WARN: no bench metrics line in {path}", flush=True)
         return 0
+    if _check_resnet_regression(data, prev_path, os.path.basename(path)):
+        return 1
     ratio = data.get("deepfm_e2e_device_ratio")
     if ratio is None:
         return 0  # artifact predates the pipeline ratio
