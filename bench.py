@@ -291,11 +291,11 @@ def bench_wmt(on_tpu: bool, peak: float):
 
     rng = np.random.default_rng(0)
     feed = {
-        "src_ids": rng.integers(0, cfg.vocab_size, (batch, src_len)).astype(np.int64),
-        "src_pos": np.tile(np.arange(src_len, dtype=np.int64), (batch, 1)),
-        "tgt_ids": rng.integers(0, cfg.vocab_size, (batch, tgt_len)).astype(np.int64),
-        "tgt_pos": np.tile(np.arange(tgt_len, dtype=np.int64), (batch, 1)),
-        "tgt_label": rng.integers(0, cfg.vocab_size, (batch, tgt_len)).astype(np.int64),
+        "src_ids": rng.integers(0, cfg.vocab_size, (batch, src_len)).astype(np.int32),
+        "src_pos": np.tile(np.arange(src_len, dtype=np.int32), (batch, 1)),
+        "tgt_ids": rng.integers(0, cfg.vocab_size, (batch, tgt_len)).astype(np.int32),
+        "tgt_pos": np.tile(np.arange(tgt_len, dtype=np.int32), (batch, 1)),
+        "tgt_label": rng.integers(0, cfg.vocab_size, (batch, tgt_len)).astype(np.int32),
         "tgt_weight": np.ones((batch, tgt_len), np.float32),
     }
     drain = "proj.b"
@@ -412,7 +412,7 @@ def bench_deepfm(on_tpu: bool):
         # accountable for (ISSUE 2 target >= 0.9; tools/gate.py flags it)
         dev_feed = {
             "sparse_ids": jax.device_put(
-                rng.integers(0, vocab, (batch, n_fields)).astype(np.int64)),
+                rng.integers(0, vocab, (batch, n_fields)).astype(np.int32)),
             "dense_x": jax.device_put(
                 rng.random((batch, n_dense)).astype(np.float32)),
             "label": jax.device_put(
@@ -465,16 +465,39 @@ def bench_deepfm(on_tpu: bool):
             guard_overhead_pct)
 
 
+def _tuned(tuner_stats: dict, name: str, fn, *args):
+    """Run one workload section with the autotuner's provenance counters
+    scoped to it: every decision the build/trace makes (conv lowering,
+    attention backend, fusion, AMP lists, buckets) lands in this
+    workload's hit-rate row. With FLAGS_tuning_mode=off no decisions
+    fire and the row records zero consults — which is exactly what
+    gate.py needs to tell 'untuned run' from 'tuned run with misses'."""
+    from paddle_tpu import tuning
+
+    tuning.reset_provenance()
+    out = fn(*args)
+    tuner_stats[name] = tuning.provenance_snapshot()
+    return out
+
+
 def main():
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu import tuning
+
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     peak = _peak_flops(dev)
 
-    tok_s, bert_mfu, bert_windows = bench_bert(on_tpu, peak)
-    img_s, rn_mfu, rn_windows, rn_ab = bench_resnet(on_tpu, peak)
-    wmt_tok_s, wmt_mfu, wmt_windows = bench_wmt(on_tpu, peak)
-    ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = bench_deepfm(on_tpu)
-    long_ctx = bench_bert_long(on_tpu)
+    tuner_stats: dict = {}
+    tok_s, bert_mfu, bert_windows = _tuned(
+        tuner_stats, "bert", bench_bert, on_tpu, peak)
+    img_s, rn_mfu, rn_windows, rn_ab = _tuned(
+        tuner_stats, "resnet50", bench_resnet, on_tpu, peak)
+    wmt_tok_s, wmt_mfu, wmt_windows = _tuned(
+        tuner_stats, "transformer_wmt", bench_wmt, on_tpu, peak)
+    ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = _tuned(
+        tuner_stats, "deepfm", bench_deepfm, on_tpu)
+    long_ctx = _tuned(tuner_stats, "bert_s512", bench_bert_long, on_tpu)
 
     # Per-workload targets. MFU workloads: the 0.45 north star
     # (BASELINE.json). DeepFM has no published number, so the declared
@@ -532,6 +555,14 @@ def main():
         # seq-512 tokens/s with the kernel off vs on (on wins ~9%)
         "bert_s512_tokens_per_sec_xla_attn": round(long_ctx["xla"], 2),
         "bert_s512_tokens_per_sec_pallas_attn": round(long_ctx["pallas"], 2),
+        # autotuner provenance (paddle_tpu/tuning/): per-workload decision
+        # counts and swept-DB hit-rate. tools/gate.py flags a consult-mode
+        # workload that resolved mostly off the DB (running untuned)
+        "tuning": {
+            "mode": tuning.mode(),
+            "db": str(pt_flags.get_flag("tuning_db")),
+            "workloads": tuner_stats,
+        },
         "config": {
             "device_kind": getattr(dev, "device_kind", "cpu"),
             "bert": "base b128 s128 AMP Adam" if on_tpu else "tiny b8 s32",

@@ -43,6 +43,11 @@ class OptimizerWithMixedPrecision:
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
+        # gray-list entries are tunable decisions (tuning/): swept-DB
+        # entries may promote/demote ops before the rewrite sees the lists
+        from .fp16_lists import apply_tuning_overrides
+
+        self._amp_lists = apply_tuning_overrides(self._amp_lists)
         rewrite_program(default_main_program(), self._amp_lists,
                         self._dest_dtype)
         helper = LayerHelper("loss_scaling")

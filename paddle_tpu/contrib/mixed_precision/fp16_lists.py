@@ -9,7 +9,8 @@ else (gray) follows its inputs — our JAX kernels are dtype-polymorphic, so
 gray needs no rewriting at all."""
 from __future__ import annotations
 
-__all__ = ["AutoMixedPrecisionLists", "white_list", "black_list", "gray_list"]
+__all__ = ["AutoMixedPrecisionLists", "white_list", "black_list", "gray_list",
+           "apply_tuning_overrides"]
 
 white_list = {
     "mul",
@@ -58,6 +59,35 @@ gray_list = {
     # doubled the lm-head logits traffic at BERT vocab sizes
     "softmax_with_cross_entropy",
 }
+
+
+def apply_tuning_overrides(lists: "AutoMixedPrecisionLists"):
+    """Gray-list membership as a tunable decision (FLAGS_tuning_mode):
+    an op the hand lists leave gray ("follow your inputs") can be promoted
+    to white (bf16 boundaries — more MXU/HBM savings) or demoted to black
+    (fp32 boundaries — numerically fragile at some site) by a swept-DB
+    entry, per device kind. Only ops still gray are touched, so a user's
+    custom_white_list/custom_black_list moves always win; the analytic
+    prior is "stay gray" (the measured hand-tuned split above), so with no
+    DB entry the lists are byte-identical to the pre-tuner ones."""
+    from ... import tuning
+
+    if tuning.mode() == "off":
+        return lists
+    for op in sorted(lists.gray_list):
+        key = tuning.canonical_key("amp_list", tuning.amp_key(op), "-",
+                                   tuning.device_kind())
+        decision, _tier = tuning.decide(
+            "amp_list", key,
+            prior=lambda: {"list": "gray"},
+            default={"list": "gray"},
+            validate=lambda dd: dd.get("list") in ("white", "black", "gray"))
+        target = decision.get("list", "gray")
+        if target != "gray":
+            lists.gray_list.discard(op)
+            (lists.white_list if target == "white"
+             else lists.black_list).add(op)
+    return lists
 
 
 class AutoMixedPrecisionLists:

@@ -96,5 +96,32 @@ def np_dtype(dtype) -> np.dtype:
     return np.dtype(d.np)
 
 
+def np_feed_dtype(dtype) -> np.dtype:
+    """The dtype a FEED array should be cast to for this runtime.
+
+    Declared int64/float64 vars (the reference API's defaults for ids and
+    labels) run as int32/float32 on the device whenever jax's x64 mode is
+    off — device_put would truncate them anyway, with jax emitting its
+    "will be truncated to dtype int32" UserWarning on every astype it sees.
+    Casting explicitly at the feed boundary keeps the truncation a stated
+    contract (and halves the host->HBM bytes of every id/label feed)
+    instead of an accident in the transfer path. With x64 enabled the
+    declared dtype is honored unchanged."""
+    dt = np_dtype(dtype)
+    if dt not in (np.dtype(np.int64), np.dtype(np.uint64),
+                  np.dtype(np.float64)):
+        return dt
+    try:
+        import jax
+
+        if jax.config.jax_enable_x64:
+            return dt
+    except Exception:  # pragma: no cover - jax not importable
+        return dt
+    return {np.dtype(np.int64): np.dtype(np.int32),
+            np.dtype(np.uint64): np.dtype(np.uint32),
+            np.dtype(np.float64): np.dtype(np.float32)}[dt]
+
+
 def is_floating(dtype) -> bool:
     return DType.parse(dtype) in (DType.FP64, DType.FP32, DType.FP16, DType.BF16)

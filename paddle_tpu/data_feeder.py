@@ -33,24 +33,46 @@ __all__ = ["DataFeeder", "ROW_MASK_NAME", "pad_feed_to_bucket"]
 ROW_MASK_NAME = "batch_mask"
 
 
+def _tuned_extent(var_name: str, dim: int, raw: int, default_extent: int) -> int:
+    """Bucket-boundary resolution through the autotuner (tuning/): the
+    pow2/HWM default is the analytic prior, a swept-DB entry (keyed by the
+    raw extent it buckets) overrides it, and sweep mode records every
+    boundary actually exercised so tools/tune.py can revisit the rounding
+    rule with measured compile/step costs. An override below the raw extent
+    is invalid (rows would be dropped) and falls through to the default."""
+    from . import tuning
+
+    if tuning.mode() == "off":
+        return default_extent
+    key = tuning.canonical_key(
+        "feed_bucket", tuning.bucket_key(var_name, dim, raw), "-",
+        tuning.device_kind())
+    decision, _tier = tuning.decide(
+        "feed_bucket", key,
+        prior=lambda: {"pad_to": default_extent},
+        default={"pad_to": default_extent},
+        validate=lambda dd: isinstance(dd.get("pad_to"), int)
+        and dd["pad_to"] >= raw)
+    return int(decision.get("pad_to", default_extent))
+
+
 def pad_feed_to_bucket(feed: dict, bucket: int,
                        mask_name: str = ROW_MASK_NAME) -> dict:
     """Pad every array's leading (batch) dim up to `bucket` rows with zeros
     and attach the [bucket, 1] float32 row mask. Always emits the mask — a
     feed whose key set changes between full and ragged batches would defeat
     the compile-cache hit bucketing exists for."""
-    rows = None
+    rows = next((np.asarray(v).shape[0] for v in feed.values()), bucket)
+    bucket = _tuned_extent("<batch>", 0, rows, bucket)
     out = {}
     for name, v in feed.items():
         arr = np.asarray(v)
-        if rows is None:
-            rows = arr.shape[0]
         if arr.shape[0] < bucket:
             pad = np.zeros((bucket - arr.shape[0],) + arr.shape[1:], arr.dtype)
             arr = np.concatenate([arr, pad])
         out[name] = arr
     mask = np.zeros((bucket, 1), np.float32)
-    mask[:rows if rows is not None else bucket] = 1.0
+    mask[:rows] = 1.0
     out[mask_name] = mask
     return out
 
@@ -91,15 +113,19 @@ class DataFeeder:
         out = {}
         for i, var in enumerate(self.feed_vars):
             cols = [np.asarray(s[i]) for s in samples]
-            dtype = var.np_dtype
+            # id/label vars declared int64 batch straight to int32 (the
+            # runtime dtype under x64-off jax): explicit at the feed
+            # boundary instead of an implicit device_put truncation
+            dtype = var.np_feed_dtype
             shapes = {c.shape for c in cols}
             if len(shapes) == 1:
                 arr = np.stack(cols).astype(dtype, copy=False)
             elif self.pad_ragged:
-                arr = _pad_stack(cols, dtype, round_ragged=bucketing)
+                arr = _pad_stack(cols, dtype, round_ragged=bucketing,
+                                 var_name=var.name)
                 if self.emit_lengths:
                     out[var.name + "_len"] = np.asarray(
-                        [c.shape[0] for c in cols], np.int64)
+                        [c.shape[0] for c in cols], np.int32)
             else:
                 raise ValueError(
                     f"ragged samples for '{var.name}' and pad_ragged=False")
@@ -122,7 +148,7 @@ class DataFeeder:
         for s in samples:
             try:
                 good.append(tuple(
-                    np.asarray(s[i]).astype(v.np_dtype, copy=False)
+                    np.asarray(s[i]).astype(v.np_feed_dtype, copy=False)
                     for i, v in enumerate(self.feed_vars)))
             except (ValueError, TypeError, IndexError, OverflowError):
                 bad += 1
@@ -135,15 +161,19 @@ class DataFeeder:
         return good
 
 
-def _pad_stack(cols, dtype, round_ragged=False):
+def _pad_stack(cols, dtype, round_ragged=False, var_name=""):
     rank = cols[0].ndim
     maxes = [max(c.shape[d] for c in cols) for d in range(rank)]
     if round_ragged:
         # bucket ragged dims to the next power of two so consecutive batches
         # with nearby max lengths share one compiled signature; uniform dims
-        # keep their exact extent (they are part of the model's shape)
-        maxes = [_round_up_pow2(m) if len({c.shape[d] for c in cols}) > 1
-                 else m for d, m in enumerate(maxes)]
+        # keep their exact extent (they are part of the model's shape). The
+        # pow2 boundary is the analytic prior of a tuned decision: a swept
+        # DB entry can coarsen/refine it per (var, dim, raw extent), and
+        # sweep mode records every boundary exercised (tuning/).
+        maxes = [_tuned_extent(var_name, d + 1, m, _round_up_pow2(m))
+                 if len({c.shape[d] for c in cols}) > 1 else m
+                 for d, m in enumerate(maxes)]
     out = np.zeros([len(cols)] + maxes, dtype)
     for i, c in enumerate(cols):
         sl = tuple(slice(0, s) for s in c.shape)

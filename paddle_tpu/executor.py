@@ -663,12 +663,15 @@ class Executor:
         for n in feed_names:
             v = feed[n]
             if not isinstance(v, jax.Array) and not is_selected_rows(v):
-                # host data: cast to the var's declared dtype; device arrays
-                # and SelectedRows (pserver sparse grads) pass through
+                # host data: cast to the var's declared RUNTIME dtype
+                # (int64/float64 declarations narrow to 32-bit here, the
+                # explicit form of the x64-off truncation device_put would
+                # apply anyway); device arrays and SelectedRows (pserver
+                # sparse grads) pass through
                 v = np.asarray(v)
                 try:
                     var = block.var(n)
-                    v = v.astype(var.np_dtype, copy=False)
+                    v = v.astype(var.np_feed_dtype, copy=False)
                 except KeyError:
                     pass
             feed_vals.append(v)
@@ -971,7 +974,7 @@ class Executor:
                 if not isinstance(v, jax.Array):
                     v = np.asarray(v)
                     try:
-                        v = v.astype(block.var(n).np_dtype, copy=False)
+                        v = v.astype(block.var(n).np_feed_dtype, copy=False)
                     except KeyError:
                         pass
                 sh = comp.feed_shardings.get(n) if (

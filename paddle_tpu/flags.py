@@ -70,6 +70,19 @@ _define("bn_fuse_stats", True,
         "discipline) instead of a separate HBM traversal of the conv "
         "output — the measured 17-35%% BN-stats share of ResNet stage time "
         "(PERF.md r5)")
+_define("tuning_mode", "off",
+        "framework-wide autotuner (paddle_tpu/tuning/): 'off' keeps every "
+        "lever on its pre-tuner logic; 'consult' resolves tunable decisions "
+        "(conv lowering, attention backend, conv+BN fusion, AMP gray ops, "
+        "bucket boundaries) through the three-tier policy exact-DB-hit -> "
+        "analytic prior -> conservative default; 'sweep' resolves "
+        "analytically but records every distinct decision key into the DB "
+        "as a candidate so tools/tune.py knows what to measure")
+_define("tuning_db", "",
+        "path of the persistent tuning decision database (schema-versioned "
+        "JSON, atomic temp+rename writes; tuning/db.py). Empty = no DB: "
+        "consult mode degrades to the analytic priors. A corrupt/missing "
+        "file warns once and falls back to analytic — never an error")
 _define("pallas_xent", False,
         "route large-vocab hard-label softmax_with_cross_entropy through "
         "the Pallas TPU kernel (ops/pallas_kernels/xent.py). Default OFF: "

@@ -103,7 +103,10 @@ class DatasetBase:
             off += w
             shape = [d for d in v.shape if d not in (-1, None)]
             arr = part.reshape([part.shape[0]] + [int(d) for d in shape])
-            feed[v.name] = arr.astype(v.np_dtype, copy=False)
+            # id/label slots declared int64 cast straight to the int32 the
+            # device runs (np_feed_dtype): explicit truncation at the feed
+            # boundary, not an implicit one in device_put
+            feed[v.name] = arr.astype(v.np_feed_dtype, copy=False)
         from . import flags
 
         if flags.get_flag("feed_bucketing"):

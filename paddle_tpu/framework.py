@@ -35,7 +35,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from . import unique_name
-from .core.types import DType, VarKind, np_dtype
+from .core.types import DType, VarKind, np_dtype, np_feed_dtype
 
 __all__ = [
     "Variable",
@@ -119,6 +119,14 @@ class Variable:
     @property
     def np_dtype(self):
         return np_dtype(self.dtype)
+
+    @property
+    def np_feed_dtype(self):
+        """Dtype FEED arrays cast to: int64/float64 declarations narrow to
+        their 32-bit runtime forms when jax x64 is off (core.types
+        .np_feed_dtype) — the explicit form of the truncation device_put
+        would apply anyway, minus jax's per-astype warning."""
+        return np_feed_dtype(self.dtype)
 
     @property
     def ndim(self):

@@ -64,33 +64,87 @@ def _block_multiple_ok(s: int) -> bool:
     return s % 128 == 0
 
 
-def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0,
-                    use_pallas=False):
-    """Dispatch (each branch measured on v5e, PERF.md):
-      * short/medium sequences: the jnp einsum composition — XLA's own
-        attention fusion is the fastest at S<=512 (beats both the bundled
-        flash kernel and the custom short-seq Pallas kernel);
-      * `use_pallas`: the hand-tuned short-seq kernel (O(S) memory with a
-        no-residual fused backward — for memory-bound configs);
-      * long sequences whose [S,S] scores outgrow VMEM/HBM budgets: jax's
-        bundled flash kernel (the only O(S) option there).
-    """
+def _pallas_short_ok(q_shape, k_shape, bias) -> bool:
     from .pallas_kernels import attention as psa
 
-    B, nh, sq, dh = q.shape
-    sk = k.shape[2]
-    if ((_on_tpu() or psa.INTERPRET) and use_pallas
-            and psa.short_seq_supported(q.shape, k.shape, bias)):
+    return ((_on_tpu() or psa.INTERPRET)
+            and psa.short_seq_supported(q_shape, k_shape, bias))
+
+
+def _flash_bundled_ok(q_shape, k_shape, dtype) -> bool:
+    sq, sk = q_shape[2], k_shape[2]
+    return (_on_tpu() and _block_multiple_ok(sq) and _block_multiple_ok(sk)
+            and dtype != jnp.float64)
+
+
+def attention_backend(q_shape, k_shape, dtype, bias=None, causal=False,
+                      use_pallas=False):
+    """Which kernel carries this attention shape. Returns (backend, tier)
+    with backend in {"xla", "pallas_short", "flash_bundled"}.
+
+    The analytic prior is the measured v5e dispatch rule (PERF.md): XLA's
+    own attention fusion at train sizes, the hand-tuned short-seq Pallas
+    kernel when the caller forces O(S) memory (`use_pallas`) and the shape
+    qualifies, the bundled flash kernel past S=1024 where the [S,S] scores
+    outgrow the chip. Under FLAGS_tuning_mode=consult a swept-DB entry for
+    the exact (shape, dtype, device) overrides the rule — this is where the
+    measured BENCH_r05 split (XLA wins at seq<=128, the Pallas kernel wins
+    ~9% at s512) becomes a cache entry instead of a per-model flag. A
+    swept backend the current build cannot execute is degraded at dispatch
+    time (flash_attention), never obeyed blindly."""
+    B, nh, sq, dh = q_shape
+    sk = k_shape[2]
+
+    def analytic():
+        if use_pallas and _pallas_short_ok(q_shape, k_shape, bias):
+            return {"backend": "pallas_short"}
+        # an O(S)-memory kernel is mandatory past S=1024 and honored
+        # whenever the caller asked for one (`use_pallas`) but the
+        # short-seq kernel's gate rejected the shape — falling to the
+        # O(S^2) reference there would silently undo the flag's documented
+        # purpose (memory-bound configs).
+        if ((sq > 1024 or (use_pallas and sq > 512))
+                and _flash_bundled_ok(q_shape, k_shape, dtype)):
+            return {"backend": "flash_bundled"}
+        return {"backend": "xla"}
+
+    from .. import tuning
+
+    if tuning.mode() == "off":
+        return analytic()["backend"], "analytic"
+    key = tuning.canonical_key(
+        "attention", tuning.attention_key(B, nh, sq, sk, dh, causal),
+        str(jnp.dtype(dtype)), tuning.device_kind())
+    decision, tier = tuning.decide(
+        "attention", key, prior=analytic, default={"backend": "xla"},
+        validate=lambda dd: dd.get("backend") in ("xla", "pallas_short",
+                                                  "flash_bundled"))
+    return decision.get("backend", "xla"), tier
+
+
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0,
+                    use_pallas=False):
+    """Dispatch per `attention_backend` (each branch measured on v5e,
+    PERF.md):
+      * "xla": the jnp einsum composition — XLA's own attention fusion is
+        the fastest at S<=512 (beats both the bundled flash kernel and the
+        custom short-seq Pallas kernel at train sizes);
+      * "pallas_short": the hand-tuned short-seq kernel (O(S) memory with a
+        no-residual fused backward — for memory-bound configs);
+      * "flash_bundled": jax's bundled flash kernel (the only O(S) option
+        once the [S,S] scores outgrow VMEM/HBM budgets).
+    A swept-DB backend the current platform/shape cannot run (e.g. a Pallas
+    verdict replayed off-TPU) degrades to the reference path here.
+    """
+    backend, _tier = attention_backend(q.shape, k.shape, q.dtype, bias,
+                                       causal, use_pallas)
+    if backend == "pallas_short" and _pallas_short_ok(q.shape, k.shape, bias):
+        from .pallas_kernels import attention as psa
+
         return psa.short_seq_attention(q, k, v, causal=causal,
                                        sm_scale=float(sm_scale))
-    # an O(S)-memory kernel is mandatory past S=1024 (the [S,S] scores
-    # outgrow the chip) and honored whenever the caller asked for one
-    # (`use_pallas`) but the short-seq kernel's gate rejected the shape —
-    # falling to the O(S^2) reference there would silently undo the flag's
-    # documented purpose (memory-bound configs).
-    if (_on_tpu() and (sq > 1024 or (use_pallas and sq > 512))
-            and _block_multiple_ok(sq)
-            and _block_multiple_ok(sk) and q.dtype != jnp.float64):
+    if backend == "flash_bundled" and _flash_bundled_ok(q.shape, k.shape,
+                                                        q.dtype):
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
         return fa.flash_attention(q, k, v, ab=bias, causal=causal,

@@ -18,6 +18,12 @@ import numpy as np
 
 from .registry import ExecContext, register_op
 
+from ..core.types import np_feed_dtype
+
+# the runtime's index dtype: int32 under x64-off jax (an astype to
+# int64 would warn-and-truncate on every trace), int64 when enabled
+_INDEX_DTYPE = np_feed_dtype("int64")
+
 _NEG = -1e30
 
 
@@ -119,5 +125,5 @@ def crf_decoding(ctx: ExecContext):
             label = label.reshape(label.shape[:-1])
         valid = jnp.arange(T)[None, :] < length[:, None]
         mism = (paths != label.astype(jnp.int32)) & valid
-        return {"ViterbiPath": mism.astype(jnp.int64)}
-    return {"ViterbiPath": paths.astype(jnp.int64)}
+        return {"ViterbiPath": mism.astype(_INDEX_DTYPE)}
+    return {"ViterbiPath": paths.astype(_INDEX_DTYPE)}

@@ -18,6 +18,12 @@ import jax.numpy as jnp
 
 from .registry import ExecContext, register_op
 
+from ..core.types import np_feed_dtype
+
+# the runtime's index dtype: int32 under x64-off jax (an astype to
+# int64 would warn-and-truncate on every trace), int64 when enabled
+_INDEX_DTYPE = np_feed_dtype("int64")
+
 _NEG = -1e30
 
 
@@ -129,8 +135,8 @@ def ctc_align(ctx: ExecContext):
     n_keep = keep.sum(axis=1).astype(jnp.int32)
     pad = jnp.asarray(int(ctx.attr("padding_value", -1)), compacted.dtype)
     out = jnp.where(t < n_keep[:, None], compacted, pad)
-    return {"Output": out.astype(jnp.int64),
-            "OutputLength": n_keep.astype(jnp.int64)}
+    return {"Output": out.astype(_INDEX_DTYPE),
+            "OutputLength": n_keep.astype(_INDEX_DTYPE)}
 
 
 @register_op("edit_distance", grad="none")
@@ -187,7 +193,7 @@ def edit_distance(ctx: ExecContext):
     if bool(ctx.attr("normalized", True)):
         dist = dist / jnp.maximum(rl[:, None].astype(jnp.float32), 1.0)
     return {"Out": dist.astype(jnp.float32),
-            "SequenceNum": jnp.asarray([B], jnp.int64)}
+            "SequenceNum": jnp.asarray([B], _INDEX_DTYPE)}
 
 
 @register_op("chunk_eval", grad="none", host=True)
@@ -199,7 +205,7 @@ def chunk_eval(ctx: ExecContext):
     import numpy as np
 
     inf = np.asarray(ctx.input("Inference")).reshape(
-        ctx.input("Inference").shape[0], -1).astype(np.int64)
+        ctx.input("Inference").shape[0], -1).astype(_INDEX_DTYPE)
     lab = np.asarray(ctx.input("Label")).reshape(inf.shape[0], -1).astype(
         np.int64)
     scheme = ctx.attr("chunk_scheme", "IOB")
@@ -207,7 +213,7 @@ def chunk_eval(ctx: ExecContext):
     excluded = set(ctx.attr("excluded_chunk_types", []) or [])
     B, T = inf.shape
     if ctx.has_input("SeqLength"):
-        ln = np.asarray(ctx.input("SeqLength")).reshape(-1).astype(np.int64)
+        ln = np.asarray(ctx.input("SeqLength")).reshape(-1).astype(_INDEX_DTYPE)
     else:
         ln = np.full((B,), T, np.int64)
 

@@ -132,6 +132,8 @@ def prefetch(ctx: ExecContext):
 
     ids = np.asarray(ctx.input("Ids"))
     idsq = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    # host op: numpy int64 on the pserver wire (giant tables can out-range
+    # int32 row ids; no jax truncation applies off-device)
     flat = idsq.reshape(-1).astype(np.int64)
     uniq, inv = np.unique(flat, return_inverse=True)
     if not sections:

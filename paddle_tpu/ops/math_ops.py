@@ -171,9 +171,12 @@ def clip_by_norm(ctx: ExecContext):
 
 @register_op("cast")
 def cast(ctx: ExecContext):
-    from ..core.types import np_dtype
+    # np_feed_dtype: a cast-to-int64 request resolves to the runtime's
+    # actual wide-int dtype (int32 under x64-off jax) instead of jax
+    # warning-and-truncating on every traced astype
+    from ..core.types import np_feed_dtype
 
-    return {"Out": ctx.input("X").astype(np_dtype(ctx.attr("out_dtype")))}
+    return {"Out": ctx.input("X").astype(np_feed_dtype(ctx.attr("out_dtype")))}
 
 
 @register_op("dot")

@@ -77,7 +77,13 @@ def _igemm_mode() -> str:
 
 
 def _igemm_take(x, w, strides, pads, d, groups, fmt) -> bool:
-    """Per-shape gate for the implicit-GEMM lowering."""
+    """Per-shape gate for the implicit-GEMM lowering.
+
+    'on'/'off' stay hard forces (the A/B arms must be able to override any
+    cache). 'auto' resolves through the autotuner when FLAGS_tuning_mode is
+    not 'off': exact swept-DB hit -> the analytic cost model above as the
+    prior -> direct conv as the conservative default. With tuning off, auto
+    is the bare analytic model — bit-for-bit the PR 5 behavior."""
     mode = _igemm_mode()
     if mode == "off" or groups != 1:
         return False
@@ -98,8 +104,28 @@ def _igemm_take(x, w, strides, pads, d, groups, fmt) -> bool:
     if mode == "on":
         return True
     cout = w.shape[0] if fmt == "NCHW" else w.shape[3]
-    return _igemm_predict_win(n, hout, wout, cin, cout, kh, kw,
-                              jnp.dtype(x.dtype).itemsize)
+    itemsize = jnp.dtype(x.dtype).itemsize
+
+    from .. import tuning
+
+    if tuning.mode() == "off":
+        return _igemm_predict_win(n, hout, wout, cin, cout, kh, kw, itemsize)
+    key = tuning.canonical_key(
+        "conv2d", tuning.conv_key(n, hout, wout, cin, cout, kh, kw,
+                                  strides, d, fmt),
+        str(jnp.dtype(x.dtype)), tuning.device_kind())
+    decision, _tier = tuning.decide(
+        "conv2d", key,
+        prior=lambda: {"lowering": "igemm" if _igemm_predict_win(
+            n, hout, wout, cin, cout, kh, kw, itemsize) else "direct"},
+        default={"lowering": "direct"},
+        # a swept verdict naming a lowering this build doesn't have falls
+        # through to the prior instead of being obeyed blindly
+        validate=lambda dd: dd.get("lowering") in ("direct", "igemm",
+                                                   "matmul_1x1"))
+    # matmul_1x1 IS the implicit-GEMM path at kh=kw=1 (the im2col collapses
+    # to a reshape, leaving the bare GEMM)
+    return decision.get("lowering") in ("igemm", "matmul_1x1")
 
 
 def _conv2d_igemm_f32(x, w, strides, pads, d, fmt):

@@ -79,7 +79,7 @@ def nce(ctx: ExecContext):
         neg = jax.random.randint(ctx.rng, (B, k), 0, C)
 
     cost = _nce_loss(x, label, w, b, neg, C, k, sampler)
-    return {"Cost": cost, "SampleLabels": neg.astype(jnp.int64)}
+    return {"Cost": cost, "SampleLabels": neg.astype(_INDEX_DTYPE)}
 
 
 @register_grad_compute("nce")
@@ -131,6 +131,12 @@ def nce_grad_maker(op, block, no_grad_set=frozenset()):
 
 
 from .registry import get_op_def  # noqa: E402
+
+from ..core.types import np_feed_dtype
+
+# the runtime's index dtype: int32 under x64-off jax (an astype to
+# int64 would warn-and-truncate on every trace), int64 when enabled
+_INDEX_DTYPE = np_feed_dtype("int64")
 
 get_op_def("nce").grad_maker = nce_grad_maker
 
@@ -241,10 +247,10 @@ def sample_logits(ctx: ExecContext):
         pad = jnp.concatenate(
             [jnp.zeros((B, NT), bool), hit], axis=1)
         adjusted = jnp.where(pad, adjusted - 1e20, adjusted)
-    return {"Samples": samples.astype(jnp.int64),
+    return {"Samples": samples.astype(_INDEX_DTYPE),
             "SampledLogits": adjusted.astype(logits.dtype),
             "SampledLabel": jnp.broadcast_to(
-                jnp.arange(NT, dtype=jnp.int64)[None, :], (B, NT)),
+                jnp.arange(NT, dtype=_INDEX_DTYPE)[None, :], (B, NT)),
             "Probabilities": q.astype(logits.dtype)}
 
 

@@ -25,6 +25,12 @@ import numpy as np
 
 from .registry import ExecContext, register_op
 
+from ..core.types import np_feed_dtype
+
+# the runtime's index dtype: int32 under x64-off jax (an astype to
+# int64 would warn-and-truncate on every trace), int64 when enabled
+_INDEX_DTYPE = np_feed_dtype("int64")
+
 _NEG_INF = -1e9
 
 
@@ -49,9 +55,7 @@ def sequence_mask(ctx: ExecContext):
         raise ValueError(
             "sequence_mask requires a static maxlen attr under XLA "
             "(data-dependent output shapes cannot be jitted)")
-    from ..core.types import np_dtype
-
-    dt = np_dtype(ctx.attr("out_dtype", "int64"))
+    dt = np_feed_dtype(ctx.attr("out_dtype", "int64"))  # int64 -> runtime int
     t = jnp.arange(int(maxlen), dtype=jnp.int32)
     return {"Y": (t[None, :] < x[:, None]).astype(dt)}
 
@@ -75,7 +79,7 @@ def sequence_pad(ctx: ExecContext):
     mask = _time_mask(ln, x.shape[1], jnp.bool_)
     mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
     out = jnp.where(mask, x, jnp.asarray(pad, x.dtype))
-    return {"Out": out, "Length": ln.astype(jnp.int64)}
+    return {"Out": out, "Length": ln.astype(_INDEX_DTYPE)}
 
 
 @register_op("sequence_unpad")
@@ -226,7 +230,7 @@ def beam_search(ctx: ExecContext):
     sel_ids = jnp.take_along_axis(
         ids.reshape(B, beam * K), top_pos, axis=1).reshape(-1, 1)
     return {
-        "selected_ids": sel_ids.astype(jnp.int64),
+        "selected_ids": sel_ids.astype(_INDEX_DTYPE),
         "selected_scores": top_scores.reshape(-1, 1),
         "parent_idx": parent.astype(jnp.int32),
     }
@@ -255,11 +259,11 @@ def beam_search_decode(ctx: ExecContext):
 
     init = jnp.arange(ids.shape[1], dtype=jnp.int32)
     _, toks = jax.lax.scan(
-        step, init, (ids.astype(jnp.int64), parents.astype(jnp.int32)),
+        step, init, (ids.astype(_INDEX_DTYPE), parents.astype(jnp.int32)),
         reverse=True)
     out = jnp.swapaxes(toks, 0, 1)  # [BW, T]
     final_scores = scores[-1].reshape(-1)
-    return {"SentenceIds": out.astype(jnp.int64),
+    return {"SentenceIds": out.astype(_INDEX_DTYPE),
             "SentenceScores": final_scores}
 
 
@@ -280,7 +284,7 @@ def sequence_slice(ctx: ExecContext):
     mask = (t < ln[:, None])
     mshape = mask.shape + (1,) * (x.ndim - 2)
     out = jnp.where(mask.reshape(mshape), gathered, jnp.zeros_like(gathered))
-    return {"Out": out, "OutLength": ln.astype(jnp.int64)}
+    return {"Out": out, "OutLength": ln.astype(_INDEX_DTYPE)}
 
 
 @register_op("sequence_erase", grad="none")
@@ -314,7 +318,7 @@ def sequence_erase(ctx: ExecContext):
     out = out.at[b_idx, dst_safe].set(jnp.where(keep, x, jnp.zeros_like(x)))
     # re-zero anything past the new length (trash writes land there)
     out = jnp.where(t < out_len[:, None], out, jnp.zeros_like(out))
-    return {"Out": out, "OutLength": out_len.astype(jnp.int64)}
+    return {"Out": out, "OutLength": out_len.astype(_INDEX_DTYPE)}
 
 
 @register_op("sequence_expand_as")

@@ -15,7 +15,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.types import np_dtype
+from ..core.types import np_dtype, np_feed_dtype
+
+# index outputs (argmax/top_k/argsort/hash) are int64 in the reference API;
+# with jax x64 off that dtype does not exist on the device and every
+# `.astype(int64)` on a tracer emits jax's "will be truncated" UserWarning
+# (one per bench tail). Request the runtime's actual index dtype instead —
+# int32 under x32, true int64 when x64 is enabled.
+_INDEX_DTYPE = np_feed_dtype("int64")
 from .registry import (
     ExecContext,
     get_op_def,
@@ -27,7 +34,9 @@ from .registry import (
 @register_op("fill_constant", grad="none")
 def fill_constant(ctx: ExecContext):
     shape = tuple(ctx.attr("shape", []))
-    dtype = np_dtype(ctx.attr("dtype", "float32"))
+    # np_feed_dtype: int64 fills narrow to int32 under x64-off jax without
+    # the per-trace truncation warning (jnp.full would warn-and-truncate)
+    dtype = np_feed_dtype(ctx.attr("dtype", "float32"))
     return {"Out": jnp.full(shape, ctx.attr("value", 0.0), dtype)}
 
 
@@ -223,12 +232,12 @@ def cumsum(ctx: ExecContext):
 
 @register_op("arg_max", grad="none")
 def arg_max(ctx: ExecContext):
-    return {"Out": jnp.argmax(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(np.int64)}
+    return {"Out": jnp.argmax(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(_INDEX_DTYPE)}
 
 
 @register_op("arg_min", grad="none")
 def arg_min(ctx: ExecContext):
-    return {"Out": jnp.argmin(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(np.int64)}
+    return {"Out": jnp.argmin(ctx.input("X"), axis=ctx.attr("axis", -1)).astype(_INDEX_DTYPE)}
 
 
 @register_op("top_k", grad="none")
@@ -236,7 +245,7 @@ def top_k(ctx: ExecContext):
     x = ctx.input("X")
     k = ctx.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(np.int64)}
+    return {"Out": vals, "Indices": idx.astype(_INDEX_DTYPE)}
 
 
 @register_op("one_hot", grad="none")
@@ -250,7 +259,9 @@ def one_hot(ctx: ExecContext):
 @register_op("range", grad="none")
 def range_op(ctx: ExecContext):
     start, end, step = ctx.attr("start"), ctx.attr("end"), ctx.attr("step")
-    dtype = np_dtype(ctx.attr("dtype", "int64"))
+    # np_feed_dtype: an int64 range request narrows to int32 under x64-off
+    # jax explicitly, instead of jnp.arange warning-and-truncating per call
+    dtype = np_feed_dtype(ctx.attr("dtype", "int64"))
     return {"Out": jnp.arange(start, end, step, dtype)}
 
 
@@ -315,7 +326,7 @@ def argsort(ctx: ExecContext):
     x = ctx.input("X")
     axis = ctx.attr("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(np.int64)}
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(_INDEX_DTYPE)}
 
 
 @register_op("linspace", grad="none")
@@ -468,7 +479,7 @@ def hash_op(ctx: ExecContext):
         row = jnp.zeros(h.shape[:-1], jnp.uint32)
         for j in range(x.shape[-1]):
             row = row * jnp.uint32(31) + h[..., j]
-        outs.append((row % jnp.uint32(mod_by)).astype(jnp.int64))
+        outs.append((row % jnp.uint32(mod_by)).astype(_INDEX_DTYPE))
     return {"Out": jnp.stack(outs, axis=-1)[..., None]}  # [.., num_hash, 1]
 
 
@@ -552,7 +563,7 @@ def unique_with_counts(ctx: ExecContext):
     import numpy as np
 
     ordered, index = _unique_ordered(ctx)
-    counts = np.bincount(index, minlength=len(ordered)).astype(np.int64)
+    counts = np.bincount(index, minlength=len(ordered)).astype(_INDEX_DTYPE)
     return {"Out": ordered, "Index": index, "Count": counts}
 
 

@@ -16,6 +16,12 @@ import numpy as np
 
 from .registry import ExecContext, register_op
 
+from ..core.types import np_feed_dtype
+
+# the runtime's index dtype: int32 under x64-off jax (an astype to
+# int64 would warn-and-truncate on every trace), int64 when enabled
+_INDEX_DTYPE = np_feed_dtype("int64")
+
 
 def _resize_dims(ctx, x):
     out_h = int(ctx.attr("out_h", 0))
@@ -344,7 +350,7 @@ def sampling_id(ctx: ExecContext):
     probability matrix."""
     p = ctx.input("X")
     return {"Out": jax.random.categorical(
-        ctx.rng, jnp.log(jnp.maximum(p, 1e-20)), axis=-1).astype(jnp.int64)}
+        ctx.rng, jnp.log(jnp.maximum(p, 1e-20)), axis=-1).astype(_INDEX_DTYPE)}
 
 
 @register_op("trilinear_interp")

@@ -127,7 +127,13 @@ class Optimizer:
         # their gradients via vjp over the fused lowering) and follow any AMP
         # rewrite (AMP's decorator calls into this backward after its own)
         from .passes import apply_minimize_passes
+        from .tuning import on_minimize
 
+        # force the tuning-DB load at minimize() time: a corrupt/missing DB
+        # warns HERE (once, attached to the graph build) and every decision
+        # below — fusion gating now, conv/attention dispatch at trace —
+        # resolves against one consistent snapshot
+        on_minimize(default_main_program())
         apply_minimize_passes(default_main_program())
         return append_backward(loss, parameter_list, no_grad_set)
 

@@ -70,6 +70,49 @@ def _match_bn_consumer(block, conv_idx: int, out_name: str):
     return bn_idx
 
 
+def _fusion_wanted(block, conv, out_name: str) -> bool:
+    """Per-pair tuner consult (FLAGS_tuning_mode != off): a swept-DB entry
+    can retire the epilogue fusion for a specific conv shape where the
+    measured A/B showed XLA declining the multi-output fusion (the PERF.md
+    r6 open question), while every other shape keeps it. The analytic prior
+    is the flag default — fuse — so with no DB entry behavior is unchanged.
+    FLAGS_bn_fuse_stats stays the master switch: the tuner refines per
+    shape, it does not resurrect a globally-retired lever."""
+    from . import tuning
+
+    if tuning.mode() == "off":
+        return True
+    in_shape = list(block.var(conv.input("Input")[0]).shape or [])
+    w_shape = list(block.var(conv.input("Filter")[0]).shape or [])
+    fmt = conv.attr("data_format", "NCHW")
+    if len(in_shape) == 4 and len(w_shape) == 4:
+        if fmt == "NCHW":
+            n, cin = in_shape[0], in_shape[1]
+            cout, kh, kw = w_shape[0], w_shape[2], w_shape[3]
+        else:
+            n, cin = in_shape[0], in_shape[3]
+            kh, kw, cout = w_shape[0], w_shape[1], w_shape[3]
+    else:  # malformed declaration: leave the decision to the default
+        n = cin = cout = kh = kw = -1
+    strides = conv.attr("strides", [1, 1])
+    dil = conv.attr("dilations", [1, 1])
+    out_var = block.var(out_name)
+    out_shape = list(out_var.shape or [])
+    hout, wout = (out_shape[2], out_shape[3]) if fmt == "NCHW" and \
+        len(out_shape) == 4 else (out_shape[1], out_shape[2]) if \
+        len(out_shape) == 4 else (-1, -1)
+    key = tuning.canonical_key(
+        "conv2d_bn_fusion",
+        tuning.conv_key(n, hout, wout, cin, cout, kh, kw, strides, dil, fmt),
+        str(out_var.dtype.value), tuning.device_kind())
+    decision, _tier = tuning.decide(
+        "conv2d_bn_fusion", key,
+        prior=lambda: {"fuse": True},
+        default={"fuse": True},
+        validate=lambda dd: isinstance(dd.get("fuse"), bool))
+    return bool(decision.get("fuse", True))
+
+
 def fuse_conv_bn_stats(program) -> int:
     """Rewrite every eligible conv2d -> batch_norm(training) pair into one
     conv2d_bn op (ops/nn_ops.py). Returns the number of pairs fused. The
@@ -86,6 +129,9 @@ def fuse_conv_bn_stats(program) -> int:
             out_name = conv.output("Output")[0]
             bn_idx = _match_bn_consumer(block, i, out_name)
             if bn_idx is None:
+                i += 1
+                continue
+            if not _fusion_wanted(block, conv, out_name):
                 i += 1
                 continue
             bn = block.ops[bn_idx]

@@ -34,7 +34,7 @@ def default_placement(feed_vars=None, device=None):
     device. jax.Arrays and SelectedRows pass through untouched."""
     from ..core.selected_rows import is_selected_rows
 
-    dtypes = {v.name: v.np_dtype for v in (feed_vars or [])}
+    dtypes = {v.name: v.np_feed_dtype for v in (feed_vars or [])}
 
     def place(feed: dict) -> dict:
         out = {}

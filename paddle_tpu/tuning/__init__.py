@@ -1,0 +1,33 @@
+"""Framework-wide autotuner: a persistent per-(op, shape, dtype, device_kind)
+decision cache with measured A/B sweeps.
+
+PR 5 proved the pattern once — a hand-built tile-fill-vs-HBM cost model
+gates the implicit-GEMM conv lowering per shape. This package generalizes
+it (ROADMAP item 3, the TVM search-over-schedules framing, arXiv:1802.04799,
+with measured sweeps replacing hand models per arXiv:2008.01040): every
+per-shape perf lever resolves through ONE three-tier policy —
+
+    exact swept-DB hit  ->  analytic prior  ->  conservative default
+
+Levers wired through it today: conv2d lowering (direct vs implicit-GEMM,
+incl. 1x1-as-matmul), attention backend (XLA fusion vs the short-seq Pallas
+kernel vs the bundled flash kernel), conv+BN epilogue fusion
+(passes.fuse_conv_bn_stats), AMP gray-op list membership, and feed-bucketing
+boundaries. The DB is populated offline by `tools/tune.py` (the
+tools/_rn_igemm.py loop made generic: median-of-windows timing, interference
+band, keep-or-retire verdict per shape) and consulted at minimize()/trace
+time under FLAGS_tuning_mode=consult; bench.py reports per-workload hit-rate
+so tools/gate.py can flag a workload running mostly untuned.
+"""
+from .db import (DB_SCHEMA, TuningDB, amp_key, attention_key, bucket_key,
+                 canonical_key, conv_key)
+from .policy import (consult_enabled, decide, device_kind, get_db,
+                     invalidate_db_cache, mode, on_minimize,
+                     provenance_snapshot, reset_provenance, sweep_enabled)
+
+__all__ = [
+    "DB_SCHEMA", "TuningDB", "canonical_key", "conv_key", "attention_key",
+    "bucket_key", "amp_key", "decide", "mode", "consult_enabled",
+    "sweep_enabled", "get_db", "invalidate_db_cache", "device_kind",
+    "provenance_snapshot", "reset_provenance", "on_minimize",
+]

@@ -344,3 +344,79 @@ def test_train_from_dataset_no_leaked_threads(tmp_path, restore_flags):
     exe.run(startup)
     exe.train_from_dataset(main, ds, print_period=10**9)
     assert _threads_settle(base), "prefetch stack leaked threads"
+
+
+# -- bucket-boundary regressions (ISSUE 6: the tuner records these) ----------
+
+def _ragged_sum_program():
+    """Ragged-dim-tolerant program honoring the row mask: the ragged x is
+    reduced over its padded dim (zero padding is sum-neutral) before the
+    static-width fc."""
+    x = L.data(name="rx", shape=[-1], dtype="float32")
+    y = L.data(name="ry", shape=[1], dtype="float32")
+    m = L.data(name=ROW_MASK_NAME, shape=[1], dtype="float32")
+    h = L.reduce_sum(x, dim=1, keep_dim=True)
+    per_row = L.square_error_cost(L.fc(h, size=1), y)
+    loss = L.elementwise_div(L.reduce_sum(L.elementwise_mul(per_row, m)),
+                             L.reduce_sum(m))
+    pt.optimizer.SGD(0.1).minimize(loss)
+    return x, y, loss
+
+
+def test_batch_exactly_on_bucket_size_compiles_once():
+    """A batch landing EXACTLY on bucket_size must share the bucketed
+    signature (no pad rows, mask all ones — and critically no rounding past
+    the bucket), so a full-then-ragged epoch is one compile. Guards the
+    boundary the tuner records as a feed_bucket decision."""
+    x, y, loss = _masked_regression_program()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    rng = np.random.default_rng(2)
+
+    def batch(n):
+        return [(rng.standard_normal(4, dtype=np.float32),
+                 rng.standard_normal(1, dtype=np.float32))
+                for _ in range(n)]
+
+    exe = pt.Executor()
+    exe.run(startup)
+    feeder = pt.DataFeeder([x, y], bucket_size=4)
+    exact = feeder.feed(batch(4))  # lands exactly on the bucket
+    assert exact["x"].shape[0] == 4
+    np.testing.assert_array_equal(exact[ROW_MASK_NAME].ravel(), [1, 1, 1, 1])
+    with jit_compile_counter() as c:
+        exe.run(main, feed=exact, fetch_list=[loss])
+        exe.run(main, feed=feeder.feed(batch(4)), fetch_list=[loss])
+        exe.run(main, feed=feeder.feed(batch(2)), fetch_list=[loss])
+    assert c.count == 1, f"boundary batch broke the signature: {c.events}"
+
+
+def test_one_past_pow2_ragged_boundary_compiles_once():
+    """Ragged-dim rounding boundaries: max extent 8 (a power of two) stays
+    8; max extent 9 (one past the boundary) rounds to 16 — ONE fresh
+    compile that every later batch up to 16 then reuses. Guards the pow2
+    decisions the tuner starts recording (data_feeder._tuned_extent)."""
+    x, y, loss = _ragged_sum_program()
+    main, startup = pt.default_main_program(), pt.default_startup_program()
+    rng = np.random.default_rng(3)
+
+    def ragged(lens):
+        return [(rng.standard_normal(n, dtype=np.float32),
+                 rng.standard_normal(1, dtype=np.float32)) for n in lens]
+
+    exe = pt.Executor()
+    exe.run(startup)
+    feeder = pt.DataFeeder([x, y], bucket_size=2)
+    at8 = feeder.feed(ragged([8, 5]))
+    assert at8["rx"].shape == (2, 8)  # exactly-pow2 max does NOT round up
+    with jit_compile_counter() as c:
+        exe.run(main, feed=at8, fetch_list=[loss])
+        exe.run(main, feed=feeder.feed(ragged([6, 8])), fetch_list=[loss])
+    assert c.count == 1, f"pow2-exact extent recompiled: {c.events}"
+
+    past = feeder.feed(ragged([9, 4]))
+    assert past["rx"].shape == (2, 16)  # one past the boundary: next pow2
+    with jit_compile_counter() as c2:
+        exe.run(main, feed=past, fetch_list=[loss])
+        exe.run(main, feed=feeder.feed(ragged([13, 11])), fetch_list=[loss])
+        exe.run(main, feed=feeder.feed(ragged([16, 2])), fetch_list=[loss])
+    assert c2.count == 1, f"16-bucket shapes fragmented: {c2.events}"

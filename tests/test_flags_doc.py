@@ -1,0 +1,42 @@
+"""Flags-documentation lint (tier-1): every FLAGS_* declared in
+paddle_tpu/flags.py must be mentioned in README.md.
+
+The drift this catches is real: by PR 6 ten flags (pallas_xent, the
+communicator knobs, profiler/debug toggles) had accumulated with README
+silence, and the new tuning flags would have joined them. A flag the README
+does not name is a lever operators cannot find — and the lint makes adding
+one a documentation act, not just a _define call.
+"""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _declared_flags() -> list[str]:
+    src = open(os.path.join(REPO, "paddle_tpu", "flags.py")).read()
+    return re.findall(r'^_define\(\s*"(\w+)"', src, flags=re.MULTILINE)
+
+
+def test_every_flag_is_documented_in_readme():
+    readme = open(os.path.join(REPO, "README.md")).read()
+    declared = _declared_flags()
+    assert declared, "flags.py parse found no _define declarations"
+    missing = [f"FLAGS_{name}" for name in declared
+               if f"FLAGS_{name}" not in readme]
+    assert not missing, (
+        f"flags declared in paddle_tpu/flags.py but absent from README.md: "
+        f"{missing} — document what each does (and its default) in the "
+        f"relevant README section")
+
+
+def test_readme_names_no_phantom_flags():
+    """The inverse drift: README mentioning a FLAGS_* that no longer exists
+    sends operators to a KeyError."""
+    readme = open(os.path.join(REPO, "README.md")).read()
+    declared = set(_declared_flags())
+    mentioned = set(re.findall(r"FLAGS_(\w+)", readme))
+    phantom = sorted(m for m in mentioned if m not in declared)
+    assert not phantom, (
+        f"README.md documents flags that paddle_tpu/flags.py no longer "
+        f"declares: {phantom}")

@@ -1,7 +1,13 @@
-"""A/B: BERT bench step with use_flash_attention True vs False."""
-import sys, time
+"""A/B: BERT bench step with use_flash_attention True vs False.
+
+Timing rides tools/_timing.py (the shared warmup + windowed protocol) so
+this harness, _rn_igemm.py and tools/tune.py all report comparable numbers.
+"""
+import sys
 sys.path.insert(0, "/root/repo")
-import jax, numpy as np
+import numpy as np  # noqa: E402
+
+from tools import _timing  # noqa: E402
 
 
 def run(use_flash):
@@ -22,22 +28,22 @@ def run(use_flash):
     exe = pt.Executor()
     with pt.scope_guard(pt.Scope()):
         exe.run(startup)
-        exe.run(main_p, feed=feed, fetch_list=[avg_loss])
-        exe.run(main_p, feed=feed)
-        np.asarray(pt.global_scope().find_var("lm_head.b"))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            exe.run(main_p, feed=feed)
-        np.asarray(pt.global_scope().find_var("lm_head.b"))
-        dt = (time.perf_counter() - t0) / iters
+        exe.run(main_p, feed=feed, fetch_list=[avg_loss])  # compile both sigs
+        m = _timing.measure(
+            lambda: exe.run(main_p, feed=feed),
+            lambda: pt.global_scope().find_var("lm_head.b"),
+            iters=iters, passes=2, warmup=1)
         (loss,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
         assert np.isfinite(float(np.asarray(loss)))
+    dt = m["median_s"]
     tokens = batch * seq_len
     H, L_, F, V = 768, 12, 3072, 30522
     n_params = L_ * (4 * H * H + 2 * H * F) + H * V
     step_flops = 6 * n_params * tokens + 12 * L_ * H * seq_len * tokens
     mfu = (step_flops / dt) / 197e12
-    print(f"use_flash={use_flash}: {dt*1e3:.1f} ms/step, {tokens/dt:,.0f} tok/s, MFU {mfu*100:.1f}%", flush=True)
+    print(f"use_flash={use_flash}: {dt*1e3:.1f} ms/step (band "
+          f"{m['band']:.3f}), {tokens/dt:,.0f} tok/s, MFU {mfu*100:.1f}%",
+          flush=True)
 
 
 run(sys.argv[1] == "1")

@@ -28,6 +28,7 @@ import paddle_tpu as pt
 from paddle_tpu import layers as L
 from paddle_tpu import profiler
 from paddle_tpu.pipeline import DeviceLoader
+from tools import _timing
 
 BATCH, DIM, HIDDEN = 256, 64, 512
 
@@ -65,18 +66,20 @@ def run_arm(pipelined: bool, n_batches: int, host_ms: float, window: int):
         exe.run(main_p, feed=next(iter(gen())), fetch_list=[loss])  # compile
         np.asarray(pt.global_scope().find_var(drain))
         profiler.stage_counters(reset=True)
-        t0 = time.perf_counter()
-        if pipelined:
-            pt.flags.set_flags({"max_inflight_steps": window})
-            for feed in DeviceLoader(gen, depth=window):
-                exe.run_async(main_p, feed=feed, fetch_list=[loss])
-            exe.wait()
-        else:
-            for feed in gen():
-                (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
-                float(np.asarray(lv))  # the per-step host drain
-        np.asarray(pt.global_scope().find_var(drain))
-        dt = time.perf_counter() - t0
+
+        def epoch():
+            if pipelined:
+                pt.flags.set_flags({"max_inflight_steps": window})
+                for feed in DeviceLoader(gen, depth=window):
+                    exe.run_async(main_p, feed=feed, fetch_list=[loss])
+                exe.wait()
+            else:
+                for feed in gen():
+                    (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
+                    float(np.asarray(lv))  # the per-step host drain
+            np.asarray(pt.global_scope().find_var(drain))
+
+        dt, _ = _timing.time_call(epoch)  # shared tools/ timing protocol
     counters = {k: round(v["seconds"], 4)
                 for k, v in profiler.stage_counters(reset=True).items()}
     return n_batches * BATCH / dt, counters

@@ -28,6 +28,7 @@ import jax  # noqa: E402
 
 import bench  # noqa: E402
 from paddle_tpu import flags  # noqa: E402
+from tools import _timing  # noqa: E402
 
 ARMS = {
     "off": ("off", False),
@@ -45,11 +46,16 @@ def main():
         flags.set_flags({"conv_implicit_gemm": igemm, "bn_fuse_stats": fuse})
         img_s, mfu, windows = bench._resnet_arm(on_tpu, peak)
         results[name] = {"img_s": round(img_s, 1), "mfu": round(mfu, 4),
-                         "windows_img_s": windows}
+                         "windows_img_s": windows,
+                         "band": round(_timing.interference_band(windows), 4)}
         print(json.dumps({"arm": name, **results[name]}), flush=True)
     base = results["off"]["img_s"]
+    # keep-or-retire per arm on the shared verdict rule (tools/_timing.py):
+    # seconds-per-image medians, band floored at gate.py's 5%
     print(json.dumps({
         "summary": {k: round(v["img_s"] / base, 4) for k, v in results.items()},
+        "verdicts": {k: _timing.ab_verdict(1.0 / base, 1.0 / v["img_s"])
+                     for k, v in results.items() if k != "off"},
         "note": "ratios vs the 'off' arm; >1.0 = lever wins end-to-end",
     }), flush=True)
 

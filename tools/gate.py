@@ -39,6 +39,11 @@ GUARD_OVERHEAD_CEIL_PCT = 2.0
 # one window, not the best-of-3 protocol, PERF.md r4/r5)
 RESNET_VS_TARGET_DROP = 0.95
 
+# a consult-mode bench whose workload resolved mostly off the swept DB is
+# running untuned — the DB is stale for these shapes (re-sweep with
+# tools/tune.py) or keyed for another device (ISSUE 6 acceptance line)
+TUNER_HIT_RATE_FLOOR = 0.5
+
 
 def run_suite() -> int:
     print("[gate] running test suite ...", flush=True)
@@ -127,6 +132,35 @@ def _check_resnet_regression(data: dict, prev_path: str | None,
     return 0
 
 
+def _check_tuner_coverage(data: dict, label: str) -> int:
+    """Flag a consult-mode bench run whose workloads resolved mostly off
+    the swept DB (ISSUE 6): decisions fell through to the analytic prior /
+    default, i.e. the workload ran untuned. Artifacts without the tuning
+    block (pre-tuner) and off-mode runs are skipped; a workload that made
+    zero tunable decisions has nothing to tune and passes."""
+    tun = data.get("tuning")
+    if not isinstance(tun, dict) or tun.get("mode") != "consult":
+        return 0
+    rc = 0
+    for wl, stats in sorted((tun.get("workloads") or {}).items()):
+        n = stats.get("decisions") or 0
+        rate = stats.get("hit_rate")
+        if n == 0 or rate is None:
+            continue
+        print(f"[gate] bench {label}: tuner {wl} hit-rate {rate} "
+              f"({stats.get('db_hits', 0)}/{n} decisions from the DB)",
+              flush=True)
+        if rate < TUNER_HIT_RATE_FLOOR:
+            print(f"[gate] FAIL: workload '{wl}' ran mostly untuned under "
+                  f"FLAGS_tuning_mode=consult (hit-rate {rate} < "
+                  f"{TUNER_HIT_RATE_FLOOR}) — the DB "
+                  f"({tun.get('db') or 'unset'}) is stale/mis-keyed for "
+                  f"these shapes; re-sweep with tools/tune.py or run with "
+                  f"tuning off", flush=True)
+            rc = 1
+    return rc
+
+
 def check_bench(path: str | None = None) -> int:
     """Flag a DeepFM end-to-end/device-path regression in the bench artifact.
 
@@ -153,6 +187,8 @@ def check_bench(path: str | None = None) -> int:
         print(f"[gate] WARN: no bench metrics line in {path}", flush=True)
         return 0
     if _check_resnet_regression(data, prev_path, os.path.basename(path)):
+        return 1
+    if _check_tuner_coverage(data, os.path.basename(path)):
         return 1
     ratio = data.get("deepfm_e2e_device_ratio")
     if ratio is None:

@@ -1,0 +1,299 @@
+"""Offline A/B sweeper: populate the tuning DB with measured verdicts.
+
+The tools/_rn_igemm.py loop made generic (ISSUE 6): for every shape in the
+sweep set, each candidate implementation is timed with the shared
+tools/_timing.py protocol (warmup, median-of-windows, interference band)
+and the keep-or-retire verdict is written into the persistent decision DB
+(paddle_tpu/tuning/) that FLAGS_tuning_mode=consult reads at minimize()/
+trace time. A tie inside the band records the ANALYTIC decision — a noise
+margin must never overwrite a cost model with a coin flip — and every entry
+carries its measured medians + band so a later reader can re-judge it.
+
+Sweeps:
+  conv       — direct vs implicit-GEMM lowering per conv shape (default
+               set: the PERF.md r6 ResNet-50 cost-table shapes; add yours
+               with repeated --conv-shape n,h,w,cin,cout,kh,kw,sh,sw).
+  attention  — XLA einsum composition vs the short-seq Pallas kernel vs
+               the bundled flash kernel per (batch, heads, seq, head_dim)
+               (default: the bench.py BERT s128 and s512 configs). Arms a
+               platform cannot run (Pallas off-TPU) are skipped.
+  candidates — every `candidate` conv2d entry a FLAGS_tuning_mode=sweep
+               run recorded into the DB gets measured and upgraded.
+
+These are per-shape microbenches — TVM-style schedule search, deliberately
+NOT the chained-per-op instrument PERF.md retired (each arm here is one
+jitted fwd+bwd of a single op, not a chain whose interactions poison the
+sum). The end-to-end confirmation stays where it always was: bench.py's
+`resnet50_lever_ab` and tools/_rn_igemm.py re-measure the composed effect
+every round, and gate.py arbitrates.
+
+    python tools/tune.py --db TUNING_DB.json                  # full sweep
+    python tools/tune.py --db x.json --what conv --iters 20
+    python tools/tune.py --db x.json --what candidates        # upgrade
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from paddle_tpu import tuning  # noqa: E402
+from paddle_tpu.ops.nn_ops import (_conv2d_igemm_f32,  # noqa: E402
+                                   _igemm_predict_win)
+from tools import _timing  # noqa: E402
+
+# The PERF.md r6 cost-table shapes (b128 NHWC, the bench configuration):
+# raw 7x7-s2 stem, the s2d 4x4 stem, s0's 3x3 and s1's 3x3. These are the
+# shapes the acceptance equivalence test replays.
+RN50_CONV_SHAPES = [
+    ("stem_7x7_s2_3ch", 128, 224, 224, 3, 64, 7, 7, (2, 2),
+     [(3, 3), (3, 3)], (1, 1)),
+    ("stem_s2d_4x4_12ch", 128, 112, 112, 12, 64, 4, 4, (1, 1),
+     [(2, 1), (2, 1)], (1, 1)),
+    ("s0_3x3_64ch", 128, 56, 56, 64, 64, 3, 3, (1, 1),
+     [(1, 1), (1, 1)], (1, 1)),
+    ("s1_3x3_128ch", 128, 28, 28, 128, 128, 3, 3, (1, 1),
+     [(1, 1), (1, 1)], (1, 1)),
+]
+
+# bench.py's two BERT attention regimes: the headline s128 (XLA wins,
+# BENCH_r05) and the s512 kernel-proof row (Pallas wins ~9%)
+ATTENTION_SHAPES = [
+    ("bert_s128", 128, 12, 128, 64, False),
+    ("bert_s512", 64, 12, 512, 64, False),
+]
+
+
+def _out_hw(h, w, kh, kw, strides, pads, d):
+    hout = (h + sum(pads[0]) - ((kh - 1) * d[0] + 1)) // strides[0] + 1
+    wout = (w + sum(pads[1]) - ((kw - 1) * d[1] + 1)) // strides[1] + 1
+    return hout, wout
+
+
+def _measure_arms(arms: dict, iters: int, passes: int) -> dict:
+    """Time every runnable arm with the shared protocol; returns
+    {name: measure-dict}. Arm values are zero-arg callables returning a
+    device array (the drain target)."""
+    out = {}
+    for name, fn in arms.items():
+        holder = {}
+
+        def run_once(fn=fn, holder=holder):
+            holder["v"] = fn()
+
+        m = _timing.measure(run_once, lambda: holder["v"], iters, passes)
+        out[name] = m
+        print(json.dumps({"arm": name, **m}), flush=True)
+    return out
+
+
+def _verdict_vs_base(measured: dict, base: str, band: float):
+    """Pick the winner against the conservative base arm: the fastest
+    candidate that beats base's median by more than max(band, its own
+    measured spread); inside the band -> tie (analytic keeps the call)."""
+    base_med = measured[base]["median_s"]
+    best, best_med = base, base_med
+    for name, m in measured.items():
+        if name != base and m["median_s"] < best_med:
+            best, best_med = name, m["median_s"]
+    if best == base:
+        return base, "retire"
+    eff_band = max(band, measured[best]["band"], measured[base]["band"])
+    v = _timing.ab_verdict(base_med, best_med, eff_band)
+    return (best, "keep") if v == "keep" else (base, v)
+
+
+def sweep_conv(db, shapes, dtype: str, iters: int, passes: int, band: float,
+               fmt: str = "NHWC"):
+    key_dtype = str(jnp.dtype(dtype))
+    rhs = "HWIO" if fmt == "NHWC" else "OIHW"
+    for row in shapes:
+        name, n, h, w, cin, cout, kh, kw, strides, pads, d = row
+        hout, wout = _out_hw(h, w, kh, kw, strides, pads, d)
+        rng = np.random.default_rng(0)
+        x_shape = (n, h, w, cin) if fmt == "NHWC" else (n, cin, h, w)
+        w_shape = (kh, kw, cin, cout) if fmt == "NHWC" \
+            else (cout, cin, kh, kw)
+        x = jax.device_put(rng.standard_normal(
+            x_shape, dtype=np.float32).astype(dtype))
+        wt = jax.device_put((rng.standard_normal(
+            w_shape, dtype=np.float32) * 0.05).astype(dtype))
+
+        def loss_direct(xx, ww):
+            out = jax.lax.conv_general_dilated(
+                xx, ww, window_strides=strides, padding=pads,
+                rhs_dilation=d, dimension_numbers=(fmt, rhs, fmt))
+            return jnp.sum(jnp.square(out.astype(jnp.float32)))
+
+        def loss_igemm(xx, ww):
+            acc = _conv2d_igemm_f32(xx, ww, strides, pads, d, fmt)
+            return jnp.sum(jnp.square(acc))
+
+        f_direct = jax.jit(jax.grad(loss_direct, argnums=(0, 1)))
+        f_igemm = jax.jit(jax.grad(loss_igemm, argnums=(0, 1)))
+        print(json.dumps({"sweep": "conv", "shape": name,
+                          "dims": f"{n}x{h}x{w}x{cin}->{cout} "
+                                  f"k{kh}x{kw}"}), flush=True)
+        measured = _measure_arms(
+            {"direct": lambda: f_direct(x, wt)[1],
+             "igemm": lambda: f_igemm(x, wt)[1]}, iters, passes)
+        winner, verdict = _verdict_vs_base(measured, "direct", band)
+        analytic = "igemm" if _igemm_predict_win(
+            n, hout, wout, cin, cout, kh, kw,
+            jnp.dtype(dtype).itemsize) else "direct"
+        lowering = winner if verdict in ("keep", "retire") else analytic
+        if verdict == "tie":
+            lowering = analytic
+        key = tuning.canonical_key(
+            "conv2d", tuning.conv_key(n, hout, wout, cin, cout, kh, kw,
+                                      strides, d, fmt),
+            key_dtype, tuning.device_kind())
+        db.put(key, {"lowering": lowering}, source="swept",
+               measured={a: {"median_s": m["median_s"], "band": m["band"]}
+                         for a, m in measured.items()},
+               note=f"{name}: verdict={verdict} analytic={analytic}")
+        print(json.dumps({"shape": name, "decision": lowering,
+                          "verdict": verdict, "analytic": analytic}),
+              flush=True)
+
+
+def sweep_attention(db, shapes, dtype: str, iters: int, passes: int,
+                    band: float):
+    from paddle_tpu.ops.attention_ops import (_flash_bundled_ok,
+                                              _pallas_short_ok,
+                                              _reference_attention)
+
+    key_dtype = str(jnp.dtype(dtype))
+    for name, b, nh, s, dh, causal in shapes:
+        rng = np.random.default_rng(0)
+        q, k, v = (jax.device_put(rng.standard_normal(
+            (b, nh, s, dh), dtype=np.float32).astype(dtype))
+            for _ in range(3))
+        sm = dh ** -0.5
+
+        def mk(attn_fn):
+            def loss(qq, kk, vv):
+                return jnp.sum(jnp.square(
+                    attn_fn(qq, kk, vv).astype(jnp.float32)))
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            return lambda: g(q, k, v)[0]
+
+        arms = {"xla": mk(lambda qq, kk, vv: _reference_attention(
+            qq, kk, vv, None, causal, sm))}
+        if _pallas_short_ok(q.shape, k.shape, None):
+            from paddle_tpu.ops.pallas_kernels import attention as psa
+
+            arms["pallas_short"] = mk(lambda qq, kk, vv:
+                                      psa.short_seq_attention(
+                                          qq, kk, vv, causal=causal,
+                                          sm_scale=sm))
+        if _flash_bundled_ok(q.shape, k.shape, q.dtype):
+            from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+            arms["flash_bundled"] = mk(lambda qq, kk, vv: fa.flash_attention(
+                qq, kk, vv, causal=causal, sm_scale=sm))
+        print(json.dumps({"sweep": "attention", "shape": name,
+                          "arms": sorted(arms)}), flush=True)
+        if len(arms) < 2:
+            print(json.dumps({"shape": name, "skipped":
+                              "only the XLA arm runs on this platform"}),
+                  flush=True)
+            continue
+        measured = _measure_arms(arms, iters, passes)
+        backend, verdict = _verdict_vs_base(measured, "xla", band)
+        key = tuning.canonical_key(
+            "attention", tuning.attention_key(b, nh, s, s, dh, causal),
+            key_dtype, tuning.device_kind())
+        db.put(key, {"backend": backend}, source="swept",
+               measured={a: {"median_s": m["median_s"], "band": m["band"]}
+                         for a, m in measured.items()},
+               note=f"{name}: verdict={verdict}")
+        print(json.dumps({"shape": name, "decision": backend,
+                          "verdict": verdict}), flush=True)
+
+
+_CONV_KEY_RE = re.compile(
+    r"^conv2d\|n=(\d+) out=(\d+)x(\d+) cin=(\d+) cout=(\d+) k=(\d+)x(\d+) "
+    r"s=(\d+)x(\d+) d=(\d+)x(\d+) (NHWC|NCHW)\|([\w.]+)\|")
+
+
+def sweep_candidates(db, iters, passes, band):
+    """Upgrade `candidate` conv2d entries (recorded by a
+    FLAGS_tuning_mode=sweep run) to measured verdicts. The input extent is
+    reconstructed pad-free from the output tile — the GEMM dims (M, folded
+    K) that drive the decision are identical either way."""
+    rows = []
+    for ckey, entry in sorted(db.entries.items()):
+        if entry.get("source") != "candidate":
+            continue
+        m = _CONV_KEY_RE.match(ckey)
+        if not m:
+            continue
+        (n, hout, wout, cin, cout, kh, kw, sh, sw, dh_, dw_) = \
+            map(int, m.groups()[:11])
+        fmt, dt = m.group(12), m.group(13)
+        h = (hout - 1) * sh + (kh - 1) * dh_ + 1
+        w = (wout - 1) * sw + (kw - 1) * dw_ + 1
+        rows.append(((dt, fmt),
+                     (f"candidate_{cin}ch_{kh}x{kw}", n, h, w, cin, cout,
+                      kh, kw, (sh, sw), [(0, 0), (0, 0)], (dh_, dw_))))
+    if not rows:
+        print(json.dumps({"sweep": "candidates", "note": "none found"}),
+              flush=True)
+        return
+    grouped: dict[tuple, list] = {}
+    for gk, row in rows:
+        grouped.setdefault(gk, []).append(row)
+    for (dt, fmt), shapes in sorted(grouped.items()):
+        sweep_conv(db, shapes, dt, iters, passes, band, fmt=fmt)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--db", default=os.environ.get("FLAGS_tuning_db",
+                                                   "TUNING_DB.json"))
+    ap.add_argument("--what", default="conv,attention",
+                    help="comma list: conv, attention, candidates")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    ap.add_argument("--iters", type=int, default=20 if on_tpu else 3)
+    ap.add_argument("--passes", type=int, default=3 if on_tpu else 2)
+    ap.add_argument("--band", type=float, default=_timing.DEFAULT_BAND)
+    ap.add_argument("--dtype", default="bfloat16" if on_tpu else "float32")
+    ap.add_argument("--small", action="store_true",
+                    help="shrink the default shape set (batch 8, CPU smoke)")
+    args = ap.parse_args()
+
+    conv_shapes = RN50_CONV_SHAPES
+    attn_shapes = ATTENTION_SHAPES
+    if args.small or not on_tpu:
+        conv_shapes = [(nm, 8, h // 4, w // 4, ci, co, kh, kw, st, pd, d)
+                       for nm, _, h, w, ci, co, kh, kw, st, pd, d
+                       in RN50_CONV_SHAPES]
+        attn_shapes = [(nm, 2, nh, s, dh, c)
+                       for nm, _, nh, s, dh, c in ATTENTION_SHAPES]
+
+    db = tuning.TuningDB(args.db)
+    what = {w.strip() for w in args.what.split(",") if w.strip()}
+    if "conv" in what:
+        sweep_conv(db, conv_shapes, args.dtype, args.iters, args.passes,
+                   args.band)
+    if "attention" in what:
+        sweep_attention(db, attn_shapes, args.dtype, args.iters,
+                        args.passes, args.band)
+    if "candidates" in what:
+        sweep_candidates(db, args.iters, args.passes, args.band)
+    db.save(args.db)
+    print(json.dumps({"db": os.path.abspath(args.db),
+                      "entries": len(db)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
