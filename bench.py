@@ -465,6 +465,39 @@ def bench_deepfm(on_tpu: bool):
             guard_overhead_pct)
 
 
+def bench_serving(on_tpu: bool):
+    """Served-load row (ISSUE 7): synthetic open-loop arrivals against a
+    small bert-decoder through the paged-KV continuous-batching engine
+    (paddle_tpu/serving/). The metrics ARE the serving SLOs: served
+    tokens/s, p50/p99 request latency, first-token latency, KV-pool
+    occupancy — and the zero-leak page count tools/gate.py hard-fails on.
+    Open-loop (arrivals never wait for the system) because a closed loop
+    self-throttles and hides queueing collapse; the workload is seeded so
+    every round replays the same arrival trace."""
+    from paddle_tpu.serving import DecoderConfig, ServingEngine, decoder_tiny
+    from tools import _serve_ab
+
+    if on_tpu:
+        cfg = DecoderConfig(vocab_size=30522, hidden_size=512, num_layers=6,
+                            num_heads=8, ffn_size=2048, max_position=1024)
+        engine = ServingEngine(cfg, page_size=16, pool_pages=2048,
+                               max_inflight=16)
+        wl = _serve_ab.synth_workload(64, cfg.vocab_size, seed=0,
+                                      prompt_lens=(16, 128), max_new=32,
+                                      rate=32.0)
+    else:
+        cfg = decoder_tiny()
+        engine = ServingEngine(cfg, page_size=4, pool_pages=64,
+                               max_inflight=4)
+        wl = _serve_ab.synth_workload(10, cfg.vocab_size, seed=0,
+                                      prompt_lens=(4, 16), max_new=4,
+                                      rate=16.0)
+    out = _serve_ab.run_open_loop(engine, wl)
+    out["config"] = ("dec6x512 b16 pool2048x16 open-loop r32" if on_tpu
+                     else "tiny pool64x4 open-loop r16")
+    return out
+
+
 def _tuned(tuner_stats: dict, name: str, fn, *args):
     """Run one workload section with the autotuner's provenance counters
     scoped to it: every decision the build/trace makes (conv lowering,
@@ -498,6 +531,7 @@ def main():
     ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = _tuned(
         tuner_stats, "deepfm", bench_deepfm, on_tpu)
     long_ctx = _tuned(tuner_stats, "bert_s512", bench_bert_long, on_tpu)
+    serving = _tuned(tuner_stats, "serving", bench_serving, on_tpu)
 
     # Per-workload targets. MFU workloads: the 0.45 north star
     # (BASELINE.json). DeepFM has no published number, so the declared
@@ -555,6 +589,11 @@ def main():
         # seq-512 tokens/s with the kernel off vs on (on wins ~9%)
         "bert_s512_tokens_per_sec_xla_attn": round(long_ctx["xla"], 2),
         "bert_s512_tokens_per_sec_pallas_attn": round(long_ctx["pallas"], 2),
+        # the serving runtime's open-loop load row (serving/): served
+        # tokens/s, p50/p99 request + first-token latency, KV-pool
+        # occupancy. tools/gate.py fails on leaked KV pages and on a
+        # served-tokens/s drop below the floor vs the previous artifact
+        "serving": serving,
         # autotuner provenance (paddle_tpu/tuning/): per-workload decision
         # counts and swept-DB hit-rate. tools/gate.py flags a consult-mode
         # workload that resolved mostly off the DB (running untuned)

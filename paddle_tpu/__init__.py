@@ -36,6 +36,7 @@ from . import inference  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import resilience  # noqa: F401
 from . import pipeline  # noqa: F401
+from . import serving  # noqa: F401
 from .pipeline import DeviceLoader  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .fluid_dataset import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

@@ -713,7 +713,11 @@ class Executor:
             while len(prog_cache) > 64:
                 prog_cache.pop(next(iter(prog_cache)))
         else:
-            prog_cache[sig] = prog_cache.pop(sig)  # LRU refresh
+            # LRU refresh, race-tolerant: cloned Predictors share this
+            # executor across threads, and a bare pop(sig) can KeyError when
+            # two runs refresh the same entry concurrently
+            prog_cache.pop(sig, None)
+            prog_cache[sig] = comp
 
         # per-step fault site (resilience/faults.py): fires once per executed
         # step, before any state is read or donated — an injected "collective

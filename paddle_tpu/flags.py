@@ -130,6 +130,29 @@ _define("feed_bucketing", False,
         "the (program, feed-signature) compile cache is hit instead of "
         "recompiling the last batch of every epoch; loss/metric ops must "
         "honor the mask for exact numerics (see README)")
+# LLM serving runtime knobs (serving/: paged KV cache + continuous batching)
+_define("serving_page_size", 16,
+        "KV-cache page size in token slots (serving/kv_cache.py): every "
+        "request's context is stored in fixed-size pages of the "
+        "preallocated HBM pool, so no request ever owns a max-seq-len "
+        "buffer. Larger pages waste tail slots; smaller pages grow the "
+        "page-table/bookkeeping overhead per decode step")
+_define("serving_pool_pages", 512,
+        "total pages in the preallocated KV pool (per layer, K and V "
+        "each). Pool bytes per layer = 2 * pages * page_size * num_heads * "
+        "head_dim * dtype_size. When the free list runs dry, admission "
+        "backpressures (requests queue) and mid-decode growth preempts the "
+        "youngest request back to the waiting queue (recompute on "
+        "re-admission)")
+_define("serving_max_inflight", 8,
+        "continuous-batching scheduler: max requests decoding concurrently "
+        "(the decode batch bucket's ceiling). Admission stops at this many "
+        "running requests even when KV pages remain")
+_define("serving_sched_policy", "fcfs",
+        "admission order for waiting requests: 'fcfs' (arrival order) or "
+        "'sjf' (shortest context first — minimizes queue latency under "
+        "mixed lengths at the cost of starving long prompts under "
+        "sustained load)")
 # distributed liveness knobs (distributed/ps_rpc.py, resilience/watchdog.py)
 _define("rpc_deadline", 180000,
         "pserver RPC deadline in MILLISECONDS (reference FLAGS_rpc_deadline, "

@@ -20,7 +20,6 @@ a zero-copy-ish dict API (run_dict) for Python callers.
 """
 from __future__ import annotations
 
-import copy
 import os
 from dataclasses import dataclass, field
 from typing import Any, Sequence
@@ -117,14 +116,15 @@ class Predictor:
                 for n, o in zip(self._fetch_names, outs)]
 
     def run_dict(self, feed: dict) -> list[np.ndarray]:
-        from ..executor import scope_guard
-
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
             raise ValueError(f"predictor missing feeds: {missing}")
-        with scope_guard(self._scope):
-            return self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_names)
+        # pass the scope explicitly instead of via scope_guard: the guard
+        # mutates a process-global scope stack, which is exactly what a
+        # cloned predictor running on a second thread must not touch
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope)
 
     def get_input_names(self) -> list[str]:
         return list(self._feed_names)
@@ -133,10 +133,23 @@ class Predictor:
         return list(self._fetch_names)
 
     def clone(self) -> "Predictor":
-        """reference PaddlePredictor::Clone — share nothing mutable; params
-        are re-read from the model dir (jax arrays themselves are immutable,
-        but scope/compile-cache state is per-predictor)."""
-        return Predictor(copy.deepcopy(self._config))
+        """reference PaddlePredictor::Clone — a second handle on the SAME
+        loaded model: the program, the parameter scope (jax arrays are
+        immutable, so sharing is read-safe) and, critically, the Executor's
+        compiled-function cache are all shared. The clone's first run() is a
+        cache HIT, not a recompile — re-wrapping the program (the old
+        behavior) paid a full XLA compile per clone, which defeats the
+        serve-from-N-threads pattern Clone exists for. Inference programs
+        write no state, so concurrent run()s from the parent and its clones
+        are safe (run_dict never touches the global scope stack)."""
+        new = object.__new__(Predictor)
+        new._config = self._config
+        new._exe = self._exe
+        new._scope = self._scope
+        new._program = self._program
+        new._feed_names = list(self._feed_names)
+        new._fetch_names = list(self._fetch_names)
+        return new
 
     # -- bf16 inference mode -------------------------------------------------
     def _to_bf16(self):

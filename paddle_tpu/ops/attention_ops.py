@@ -166,6 +166,202 @@ def fused_attention(ctx: ExecContext):
 
 
 # ---------------------------------------------------------------------------
+# Paged KV-cache decode attention (the serving/ runtime's core op)
+# ---------------------------------------------------------------------------
+
+
+def _pallas_paged_ok(q_shape, pool_shape) -> bool:
+    from .pallas_kernels import paged_attention as ppa
+
+    return ((_on_tpu() or ppa.INTERPRET)
+            and ppa.paged_supported(tuple(q_shape), tuple(pool_shape)))
+
+
+def paged_attention_backend(batch, num_heads, kv_slots, head_dim, dtype,
+                            pool_shape=None):
+    """Which kernel carries one ragged decode-attention shape (sq=1, sk =
+    the padded slot count P*page_size). Returns (backend, tier) with backend
+    in {"xla", "pallas_paged"}.
+
+    Same three-tier contract as `attention_backend` (the PR 6 lever): the
+    analytic prior prefers the Pallas paged kernel wherever it can run (the
+    gather-free DMA path is the whole point of paging, arXiv:2604.15464),
+    a swept DB entry for the exact (b, nh, 1, sk, dh) key overrides it —
+    tools/tune.py's decode sweep writes those — and a swept backend the
+    current build cannot execute degrades at dispatch, never obeyed blindly.
+    """
+    def analytic():
+        if pool_shape is not None and _pallas_paged_ok(
+                (batch, num_heads, head_dim), pool_shape):
+            return {"backend": "pallas_paged"}
+        return {"backend": "xla"}
+
+    from .. import tuning
+    from .registry import _DYN
+
+    # build-time shape inference dry-runs the compute with the dynamic-batch
+    # sentinel; that fake shape must not consult the DB nor be recorded as a
+    # sweep candidate (it is not a real dispatch)
+    if tuning.mode() == "off" or batch == _DYN:
+        return analytic()["backend"], "analytic"
+    key = tuning.canonical_key(
+        "attention",
+        tuning.attention_key(batch, num_heads, 1, kv_slots, head_dim, True),
+        str(jnp.dtype(dtype)), tuning.device_kind())
+    decision, tier = tuning.decide(
+        "attention", key, prior=analytic, default={"backend": "xla"},
+        validate=lambda dd: dd.get("backend") in ("xla", "pallas_paged"))
+    return decision.get("backend", "xla"), tier
+
+
+def _paged_attention_reference(q, k_pool, v_pool, page_table, kv_lens,
+                               sm_scale=1.0):
+    """XLA gather-based paged decode attention — the numeric oracle and the
+    dispatch fallback. Gathers every row's pages into a dense
+    [B, P*ps, nh, dh] view (XLA fuses the gather into the matmuls, but the
+    materialized bytes still move); fp32 softmax statistics, slots past a
+    row's kv_len masked with the framework-wide -1e9 convention so a padded
+    row (kv_len 0) stays finite."""
+    B, nh, dh = q.shape
+    num_pages, ps = k_pool.shape[0], k_pool.shape[1]
+    P = page_table.shape[1]
+    pt = jnp.clip(page_table, 0, num_pages - 1)
+    k = k_pool[pt].reshape(B, P * ps, nh, dh)
+    v = v_pool[pt].reshape(B, P * ps, nh, dh)
+    s = jnp.einsum("bhd,bkhd->bhk", q, k) * sm_scale
+    s = s.astype(jnp.float32)
+    pos = jnp.arange(P * ps, dtype=jnp.int32)
+    s = jnp.where(pos[None, None, :] < kv_lens[:, None, None], s, _NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", probs.astype(q.dtype), v)
+
+
+def paged_decode_attention_fn(q, k_pool, v_pool, page_table, kv_lens,
+                              sm_scale=1.0):
+    """Dispatch per `paged_attention_backend`: the Pallas page-DMA kernel
+    where it can run (and the tuner has not retired it for this shape), the
+    XLA gather reference everywhere else — including when a swept-DB verdict
+    names a kernel this platform cannot execute."""
+    B, nh, dh = q.shape
+    P, ps = page_table.shape[1], k_pool.shape[1]
+    backend, _tier = paged_attention_backend(B, nh, P * ps, dh, q.dtype,
+                                             pool_shape=k_pool.shape)
+    if backend == "pallas_paged" and _pallas_paged_ok(q.shape, k_pool.shape):
+        from .pallas_kernels import paged_attention as ppa
+
+        return ppa.paged_decode_attention(q, k_pool, v_pool, page_table,
+                                          kv_lens, sm_scale=float(sm_scale))
+    return _paged_attention_reference(q, k_pool, v_pool, page_table, kv_lens,
+                                      sm_scale)
+
+
+# sentinel page index far past any real pool: scatters routed here are
+# dropped (mode="drop"), which is how masked rows / padded positions skip
+# their KV write without a branch
+_DROP_PAGE = 1 << 30
+
+
+def kv_cache_append_fn(k_pool, v_pool, k, v, page_table, positions,
+                       live=None):
+    """Write one decode step's K/V into the paged pool.
+
+    k/v: [B, nh, dh] (this token's projections); positions: [B] int32 — the
+    logical slot each row writes (its current context length); live: [B]
+    0/1 mask (rows the scheduler padded in write nowhere). Returns the
+    updated pools; the executor's donation makes the update in-place in HBM.
+    """
+    ps = k_pool.shape[1]
+    P = page_table.shape[1]
+    page_of = jnp.clip(positions // ps, 0, P - 1)
+    page_idx = jnp.take_along_axis(page_table, page_of[:, None], axis=1)[:, 0]
+    slot = positions % ps
+    if live is not None:
+        page_idx = jnp.where(jnp.reshape(live, (-1,)) > 0, page_idx,
+                             _DROP_PAGE)
+    k_pool = k_pool.at[page_idx, slot].set(k.astype(k_pool.dtype),
+                                           mode="drop")
+    v_pool = v_pool.at[page_idx, slot].set(v.astype(v_pool.dtype),
+                                           mode="drop")
+    return k_pool, v_pool
+
+
+def kv_cache_prefill_write_fn(k_pool, v_pool, k, v, page_table, lens):
+    """Write a prefill's whole-context K/V into the paged pool.
+
+    k/v: [B, nh, S, dh] (the prefill attention's per-layer projections, in
+    head-major layout as the encoder produces them); lens: [B] int32 actual
+    prompt lengths — positions s >= lens[b] (bucket padding) are dropped.
+    """
+    B, nh, S, dh = k.shape
+    ps = k_pool.shape[1]
+    P = page_table.shape[1]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    page_idx = jnp.take_along_axis(
+        page_table, jnp.clip(pos // ps, 0, P - 1)[None, :].repeat(B, 0),
+        axis=1)                                           # [B, S]
+    page_idx = jnp.where(pos[None, :] < lens[:, None], page_idx, _DROP_PAGE)
+    slot = jnp.broadcast_to(pos % ps, (B, S))
+    k_bs = jnp.transpose(k, (0, 2, 1, 3))                 # [B, S, nh, dh]
+    v_bs = jnp.transpose(v, (0, 2, 1, 3))
+    k_pool = k_pool.at[page_idx, slot].set(k_bs.astype(k_pool.dtype),
+                                           mode="drop")
+    v_pool = v_pool.at[page_idx, slot].set(v_bs.astype(v_pool.dtype),
+                                           mode="drop")
+    return k_pool, v_pool
+
+
+@register_op("paged_decode_attention", grad="none")
+def paged_decode_attention_op(ctx: ExecContext):
+    """inputs: Q [B, nh, dh], KPool/VPool [pages, ps, nh, dh], PageTable
+    [B, P] int32, Positions [B] int32 (current slot index; the context this
+    step attends over is 0..Positions inclusive — the just-appended token
+    attends to itself); attrs: sm_scale. Output: [B, nh, dh]."""
+    q = ctx.input("Q")
+    kp, vp = ctx.input("KPool"), ctx.input("VPool")
+    out = paged_decode_attention_fn(
+        q, kp, vp, ctx.input("PageTable"),
+        ctx.input("Positions").astype(jnp.int32) + 1,
+        sm_scale=ctx.attr("sm_scale", 1.0))
+    return {"Out": out.astype(q.dtype)}
+
+
+@register_op("kv_cache_append", grad="none")
+def kv_cache_append_op(ctx: ExecContext):
+    """inputs: KPool/VPool, K/V [B, nh, dh], PageTable [B, P], Positions
+    [B], optional Mask [B, 1] (the batch_mask row-mask convention: masked
+    rows write nothing). Outputs KPoolOut/VPoolOut — the serving programs
+    name these the SAME vars as the inputs, so the executor classifies the
+    pools read-write and donates their buffers (in-place HBM update)."""
+    live = ctx.input("Mask") if ctx.has_input("Mask") else None
+    kp, vp = kv_cache_append_fn(
+        ctx.input("KPool"), ctx.input("VPool"), ctx.input("K"),
+        ctx.input("V"), ctx.input("PageTable"),
+        ctx.input("Positions").astype(jnp.int32), live)
+    return {"KPoolOut": kp, "VPoolOut": vp}
+
+
+@register_op("kv_cache_prefill_write", grad="none")
+def kv_cache_prefill_write_op(ctx: ExecContext):
+    """inputs: KPool/VPool, K/V [B, nh, S, dh], PageTable [B, P], Lens [B].
+    Same in-place output aliasing contract as kv_cache_append."""
+    kp, vp = kv_cache_prefill_write_fn(
+        ctx.input("KPool"), ctx.input("VPool"), ctx.input("K"),
+        ctx.input("V"), ctx.input("PageTable"),
+        ctx.input("Lens").astype(jnp.int32))
+    return {"KPoolOut": kp, "VPoolOut": vp}
+
+
+@register_op("gather_token_logits", grad="none")
+def gather_token_logits_op(ctx: ExecContext):
+    """inputs: X [B, S, V], Lens [B] — output [B, V]: row b's logits at
+    position Lens[b]-1 (the last real token of a bucket-padded prefill)."""
+    x = ctx.input("X")
+    lens = ctx.input("Lens").astype(jnp.int32)
+    idx = jnp.clip(lens - 1, 0, x.shape[1] - 1)[:, None, None]
+    return {"Out": jnp.take_along_axis(x, idx, axis=1)[:, 0, :]}
+
+
+# ---------------------------------------------------------------------------
 # Ring attention (sequence parallelism over the `sp` axis)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,156 @@
+"""Ragged paged decode attention: a Pallas TPU kernel over the KV-page pool.
+
+Why this exists (ROADMAP item 1, "Ragged Paged Attention", arXiv:2604.15464):
+the serving runtime's decode step is one query token per request attending
+over that request's whole context, which lives scattered across fixed-size
+pages of the preallocated HBM pool. The XLA reference path
+(attention_ops._paged_attention_reference) gathers every row's pages into a
+dense [B, P*ps, nh, dh] tensor first — at long contexts that materialized
+gather IS the decode step's HBM bill. This kernel never materializes it:
+
+  * grid (batch row, page): the page index for each grid step comes from the
+    request's page table via scalar prefetch — the BlockSpec index_map reads
+    `page_table[b, p]` and DMAs exactly that [ps, nh, dh] page slab from the
+    pool, so HBM traffic is the used pages once, nothing else.
+  * the ragged part: rows in one batch have different context lengths
+    (`kv_lens`, also scalar-prefetched). Slots past a row's length are masked
+    to -1e9 inside the online-softmax update; rows the continuous-batching
+    scheduler padded in (kv_len 0) produce finite garbage nobody reads — the
+    batch_mask convention from PR 2.
+  * online softmax state (m, l, acc) lives in VMEM scratch across the page
+    steps of one row (grid dims are ("parallel", "arbitrary")); the output
+    block is written once, on the row's last page step.
+
+Decode q is a single token per row, so there is no backward pass: the kernel
+is forward-only (serving never differentiates), which keeps it free of the
+residual bookkeeping the short-seq training kernel needs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+# tests flip this to run the kernel through the Pallas interpreter on CPU
+INTERPRET = False
+
+
+def paged_supported(q_shape, pool_shape) -> bool:
+    """Shapes this kernel handles: q [B, nh, dh] against a pool
+    [num_pages, page_size, nh, dh]. dh must be sublane-aligned; the per-page
+    slab [ps, nh, dh] must be modest enough to double-buffer in VMEM."""
+    if len(q_shape) != 3 or len(pool_shape) != 4:
+        return False
+    B, nh, dh = q_shape
+    num_pages, ps, p_nh, p_dh = pool_shape
+    return (nh == p_nh and dh == p_dh and dh % 8 == 0 and dh <= 256
+            and ps * nh * dh * 4 <= 2 * 1024 * 1024)
+
+
+def _compiler_params():
+    # jax moved CompilerParams -> TPUCompilerParams and back across versions
+    cp = (getattr(pltpu, "CompilerParams", None)
+          or getattr(pltpu, "TPUCompilerParams"))
+    return cp(dimension_semantics=("parallel", "arbitrary"))
+
+
+def _decode_kernel(pt_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, sm_scale, page_size, num_pages_p):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale      # [nh, dh]
+    k = k_ref[0].astype(jnp.float32)                 # [ps, nh, dh]
+    v = v_ref[0].astype(jnp.float32)
+    # batched-over-heads q.k^T: [nh, dh] x [ps, nh, dh] -> [nh, ps]
+    s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                            preferred_element_type=jnp.float32)
+    # ragged mask: slot p*ps + j is live iff it is below this row's context
+    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+    s = jnp.where(pos < kl_ref[b], s, _NEG_INF)
+
+    m_prev, l_prev = m_ref[...], l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.exp(s - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_prev * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    # [nh, ps] x [ps, nh, dh] -> [nh, dh]
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        pexp, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(p == num_pages_p - 1)
+    def _emit():
+        # a padded row (kv_len 0) has l == 0: emit zeros, not NaN — the
+        # scheduler's batch_mask guarantees nobody reads it either way
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _call(q, k_pool, v_pool, page_table, kv_lens, sm_scale, interpret):
+    B, nh, dh = q.shape
+    num_pages, ps = k_pool.shape[0], k_pool.shape[1]
+    P = page_table.shape[1]
+    # clamp so a padded/garbage table entry DMAs a real page (its slots are
+    # masked by kv_lens anyway) instead of reading out of bounds
+    page_table = jnp.clip(page_table, 0, num_pages - 1).astype(jnp.int32)
+    kv_lens = kv_lens.astype(jnp.int32)
+    kernel = functools.partial(_decode_kernel, sm_scale=float(sm_scale),
+                               page_size=ps, num_pages_p=P)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, nh, dh), lambda b, p, pt, kl: (b, 0, 0)),
+            pl.BlockSpec((1, ps, nh, dh),
+                         lambda b, p, pt, kl: (pt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, ps, nh, dh),
+                         lambda b, p, pt, kl: (pt[b, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, dh), lambda b, p, pt, kl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 1), jnp.float32),   # running max
+            pltpu.VMEM((nh, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((nh, dh), jnp.float32),  # running numerator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=B * nh * 2 * 2 * P * ps * dh,
+            bytes_accessed=(2 * B * P * ps * nh * dh * k_pool.dtype.itemsize
+                            + 2 * B * nh * dh * q.dtype.itemsize),
+            transcendentals=B * nh * P * ps),
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(page_table, kv_lens, q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, kv_lens,
+                           sm_scale=1.0):
+    """One decode step of ragged paged attention.
+
+    q: [B, nh, dh] (this step's query per request row);
+    k_pool/v_pool: [num_pages, page_size, nh, dh] (the preallocated pool);
+    page_table: [B, P] int32 (row b's context lives in pages
+    page_table[b, 0..ceil(kv_lens[b]/page_size))); kv_lens: [B] int32 valid
+    slot counts. Returns [B, nh, dh] in q's dtype. Callers gate on
+    `paged_supported`.
+    """
+    return _call(q, k_pool, v_pool, page_table, kv_lens,
+                 float(sm_scale), bool(INTERPRET))

@@ -31,6 +31,12 @@ host, reproducibly. This module plants named *sites* in the hot paths —
                       scaled 1e4x, driving a finite loss spike that the
                       sentinel's EMA gate (FLAGS_guard_spike_factor) must
                       catch
+    serving_abort     ServingEngine.step, once per scheduler iteration —
+                      the oldest running generate-request is aborted
+                      mid-decode (the client vanished), so its KV pages
+                      must return to the free list; the chaos test drives
+                      repeated abort cycles and asserts the pool leaks
+                      zero pages
 
 — and a *plan* that decides, per site and per hit, whether to raise an
 `InjectedFault`. Plans are either explicit hit schedules or seeded Bernoulli
@@ -61,7 +67,7 @@ __all__ = ["FAULT_SITES", "InjectedFault", "FaultPlan", "fault_point",
 FAULT_SITES = frozenset({
     "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
     "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
-    "numeric_nan", "numeric_spike",
+    "numeric_nan", "numeric_spike", "serving_abort",
 })
 
 

@@ -1,0 +1,34 @@
+"""LLM serving runtime: paged KV cache + continuous batching + ragged
+paged decode attention (ROADMAP item 1; "Ragged Paged Attention",
+arXiv:2604.15464 for the kernel, "Tensor Processing Primitives",
+arXiv:2104.05755 for the reusable-primitive framing).
+
+Three pieces, one runtime:
+  * `kv_cache`   — fixed-size pages over a preallocated HBM pool (device
+                   side: persistable pool vars the compiled steps update in
+                   place; host side: free-list + per-request page tables);
+  * `model`      — the served decoder expressed as bucketed prefill /
+                   ragged decode programs over one explicit weight
+                   namespace (plus the dense oracle for equivalence tests);
+  * `engine`     — the continuous-batching scheduler: admit/evict between
+                   decode steps, backpressure on pool exhaustion,
+                   recompute-style preemption, chaos-abort page reclamation.
+
+Knobs: FLAGS_serving_page_size, FLAGS_serving_pool_pages,
+FLAGS_serving_max_inflight, FLAGS_serving_sched_policy (see README
+"Serving"). Load: tools/_serve_ab.py (open-loop arrival sweep) and the
+bench.py `serving` block (served tokens/s, p50/p99 latency, pool occupancy)
+gated by tools/gate.py.
+"""
+from .engine import ContinuousBatchingScheduler, GenRequest, ServingEngine
+from .kv_cache import PagedKVPool, create_device_pools, pool_var_names
+from .model import (DecoderConfig, build_decode_program,
+                    build_full_forward_program, build_prefill_program,
+                    decoder_tiny)
+
+__all__ = [
+    "ServingEngine", "GenRequest", "ContinuousBatchingScheduler",
+    "PagedKVPool", "pool_var_names", "create_device_pools",
+    "DecoderConfig", "decoder_tiny", "build_prefill_program",
+    "build_decode_program", "build_full_forward_program",
+]

@@ -1,0 +1,383 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The scheduling loop the "millions of users" scenario needs (ROADMAP item 1):
+requests arrive at any time, and the engine admits/evicts them BETWEEN
+decode steps instead of running fixed generation batches:
+
+    step():  (maybe) inject a chaos abort -> admit waiting requests while
+             pages + inflight slots allow (prefill each, bucketed) ->
+             grow/allocate pages for the next token slot (preempting the
+             youngest request on pool exhaustion) -> one ragged decode step
+             over ALL running requests -> retire finished rows.
+
+Compile discipline (the PR 2 machinery doing serving duty):
+  * prefill compiles once per prompt-length bucket (pow2 rounding, the
+    shape-bucketing convention);
+  * decode compiles once per (batch-bucket, page-count-bucket) — rows are
+    padded up to the batch bucket and masked with the `batch_mask` row-mask
+    convention, page tables padded to the page bucket (masked by length);
+  * `stats["prefill_signatures"]/["decode_signatures"]` record exactly which
+    buckets compiled, so tests can assert the open-loop run compiled decode
+    at most once per bucket (via pipeline.jit_compile_counter).
+
+Failure/backpressure semantics:
+  * admission backpressure: a request whose context needs more pages than
+    the free list holds (or when max_inflight is reached) WAITS — the pool
+    can never be oversubscribed;
+  * mid-decode growth: when a running request crosses a page boundary and
+    the pool is dry, the YOUNGEST running request is preempted back to the
+    waiting queue (pages freed; on re-admission its prompt+generated prefix
+    is re-prefilled — recompute-style preemption, exact under greedy
+    decoding);
+  * abort (client gone, or the `serving_abort` chaos fault site): the
+    request's pages return to the free list immediately — the
+    zero-leak invariant the chaos test pins down.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import flags, unique_name
+from ..data_feeder import _round_up_pow2
+from ..executor import Executor, Scope
+from ..framework import Program, program_guard
+from ..resilience.faults import InjectedFault, fault_point
+from . import model as sv_model
+from .kv_cache import PagedKVPool, create_device_pools
+
+__all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine"]
+
+WAITING, RUNNING, FINISHED, ABORTED = "waiting", "running", "finished", "aborted"
+
+
+class GenRequest:
+    """One generate request's lifetime.
+
+    `all_tokens` is the full sequence so far (prompt + generated); the KV
+    cache always holds exactly len(all_tokens) - 1 slots while RUNNING (the
+    newest token's KV is written by the decode step that consumes it). On
+    preemption the pages are dropped and the whole prefix re-prefills — no
+    separate bookkeeping for "how much cache survived".
+    """
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int, eos_id=None):
+        if not len(prompt):
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.rid = rid
+        self.prompt_len = len(prompt)
+        self.all_tokens: list[int] = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.state = WAITING
+        self.pages: list[int] = []
+        self.admit_seq = -1  # admission order; preemption evicts the newest
+        self.preemptions = 0
+        self.arrival_t = time.perf_counter()
+        self.t_first_token: float | None = None
+        self.t_done: float | None = None
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.all_tokens) - self.prompt_len
+
+    @property
+    def out_tokens(self) -> list[int]:
+        return self.all_tokens[self.prompt_len:]
+
+    @property
+    def cache_len(self) -> int:
+        """Valid KV slots while RUNNING (last token not yet appended)."""
+        return len(self.all_tokens) - 1
+
+    def is_done(self) -> bool:
+        return (self.n_generated >= self.max_new_tokens
+                or (self.eos_id is not None and self.n_generated > 0
+                    and self.all_tokens[-1] == self.eos_id))
+
+
+class ContinuousBatchingScheduler:
+    """Admission ordering policy over the waiting queue."""
+
+    def __init__(self, policy: str):
+        if policy not in ("fcfs", "sjf"):
+            raise ValueError(f"unknown FLAGS_serving_sched_policy "
+                             f"'{policy}' (fcfs | sjf)")
+        self.policy = policy
+
+    def order(self, waiting: list[GenRequest]) -> list[GenRequest]:
+        if self.policy == "sjf":
+            # stable sort: equal lengths keep arrival order
+            return sorted(waiting, key=lambda r: len(r.all_tokens))
+        return list(waiting)
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching runtime for one decoder model.
+
+    Single-threaded by design (one scheduler loop owns the pool and the
+    scope); the parallelism is inside the compiled steps.
+    """
+
+    def __init__(self, cfg: "sv_model.DecoderConfig | None" = None,
+                 page_size: int | None = None,
+                 pool_pages: int | None = None,
+                 max_inflight: int | None = None,
+                 policy: str | None = None,
+                 seed: int = 0):
+        self.cfg = cfg or sv_model.decoder_tiny()
+        self.page_size = int(page_size
+                             or flags.get_flag("serving_page_size"))
+        self.pool_pages = int(pool_pages
+                              or flags.get_flag("serving_pool_pages"))
+        self.max_inflight = int(max_inflight
+                                or flags.get_flag("serving_max_inflight"))
+        self.scheduler = ContinuousBatchingScheduler(
+            policy or str(flags.get_flag("serving_sched_policy")))
+        self.pool = PagedKVPool(self.pool_pages, self.page_size)
+        self._exe = Executor()
+        self._scope = Scope()
+
+        self._prefill_prog = Program()
+        self._decode_prog = Program()
+        startup = Program()
+        decoy_startup = Program()  # decode re-declares params; inits unused
+        self._prefill_prog.random_seed = startup.random_seed = int(seed)
+        with program_guard(self._prefill_prog, startup), \
+                unique_name.guard():
+            self._prefill_io = sv_model.build_prefill_program(
+                self.cfg, self.pool_pages, self.page_size)
+        with program_guard(self._decode_prog, decoy_startup), \
+                unique_name.guard():
+            self._decode_io = sv_model.build_decode_program(
+                self.cfg, self.pool_pages, self.page_size)
+        self._exe.run(startup, scope=self._scope)
+        create_device_pools(self._scope, self.cfg.num_layers,
+                            self.pool_pages, self.page_size,
+                            self.cfg.num_heads, self.cfg.head_dim,
+                            self.cfg.dtype)
+
+        self.requests: dict[int, GenRequest] = {}
+        self._waiting: list[GenRequest] = []
+        self._running: list[GenRequest] = []
+        self._next_rid = 0
+        self._admit_seq = 0
+        self.stats = {
+            "prefills": 0, "decode_steps": 0, "decode_tokens": 0,
+            "preemptions": 0, "aborts": 0,
+            "prefill_signatures": set(), "decode_signatures": set(),
+            "peak_pages_in_use": 0, "occupancy_sum": 0.0, "occupancy_n": 0,
+        }
+
+    # -- client API ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, eos_id=None) -> int:
+        if len(prompt) + max_new_tokens > self.cfg.max_position:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_position {self.cfg.max_position}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = GenRequest(rid, prompt, max_new_tokens, eos_id)
+        self.requests[rid] = req
+        self._waiting.append(req)
+        return rid
+
+    def abort(self, rid: int) -> None:
+        """Drop a request wherever it is; its pages return to the free list
+        immediately (the zero-leak contract the chaos test asserts)."""
+        req = self.requests.get(rid)
+        if req is None or req.state in (FINISHED, ABORTED):
+            return
+        if req in self._waiting:
+            self._waiting.remove(req)
+        if req in self._running:
+            self._running.remove(req)
+        self._release(req)
+        req.state = ABORTED
+        req.t_done = time.perf_counter()
+        self.stats["aborts"] += 1
+
+    def has_work(self) -> bool:
+        return bool(self._waiting or self._running)
+
+    def result(self, rid: int) -> list[int]:
+        return list(self.requests[rid].out_tokens)
+
+    def run_until_drained(self, max_steps: int = 100_000) -> None:
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"serving loop made no exit after {max_steps} steps "
+                    f"(waiting={len(self._waiting)} "
+                    f"running={len(self._running)})")
+
+    # -- the scheduler iteration --------------------------------------------
+    def step(self) -> bool:
+        """One continuous-batching iteration; returns True if any request
+        made progress (admitted or decoded a token)."""
+        try:
+            fault_point("serving_abort")
+        except InjectedFault:
+            # chaos: the oldest running request's client vanished mid-decode
+            victim = self._running[0] if self._running else (
+                self._waiting[0] if self._waiting else None)
+            if victim is not None:
+                self.abort(victim.rid)
+        admitted = self._admit()
+        decoded = self._decode_once() if self._running else False
+        if not decoded and not admitted and self._waiting:
+            need = min(self.pool.pages_for(len(r.all_tokens) + 1)
+                       for r in self._waiting)
+            if need > self.pool.num_pages:
+                raise RuntimeError(
+                    f"request needs {need} pages but the pool only has "
+                    f"{self.pool.num_pages} (FLAGS_serving_pool_pages / "
+                    f"FLAGS_serving_page_size)")
+            if not self._running:
+                raise RuntimeError(
+                    "admission stuck: no running requests to free pages, "
+                    f"yet {len(self._waiting)} waiting (free "
+                    f"{self.pool.free_count}/{self.pool.num_pages} pages)")
+        self._note_occupancy()
+        return bool(admitted or decoded)
+
+    # -- internals ----------------------------------------------------------
+    def _release(self, req: GenRequest) -> None:
+        if req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+
+    def _note_occupancy(self) -> None:
+        used = self.pool.pages_in_use
+        self.stats["peak_pages_in_use"] = max(
+            self.stats["peak_pages_in_use"], used)
+        self.stats["occupancy_sum"] += used / self.pool.num_pages
+        self.stats["occupancy_n"] += 1
+
+    def _admit(self) -> int:
+        """Admit waiting requests in policy order until pages or inflight
+        slots run out. Head-of-line backpressure: the first request that
+        does not fit stops admission (no starvation of big requests by
+        later small ones under fcfs)."""
+        admitted = 0
+        for req in self.scheduler.order(self._waiting):
+            if len(self._running) >= self.max_inflight:
+                break
+            # +1: the decode step after prefill writes one more slot
+            need = self.pool.pages_for(len(req.all_tokens) + 1)
+            pages = self.pool.allocate(need)
+            if pages is None:
+                break
+            req.pages = pages
+            self._waiting.remove(req)
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self._prefill(req)
+            admitted += 1
+        return admitted
+
+    def _seq_bucket(self, n: int) -> int:
+        return min(self.cfg.max_position, max(8, _round_up_pow2(n)))
+
+    def _prefill(self, req: GenRequest) -> None:
+        """Run the bucketed prefill for one request: writes its context's
+        K/V into its pages and produces its first new token."""
+        n = len(req.all_tokens)
+        sb = self._seq_bucket(n)
+        pb = max(len(req.pages), self.pool.pages_for(sb))
+        tok = np.zeros((1, sb), np.int32)
+        tok[0, :n] = req.all_tokens
+        pos = np.arange(sb, dtype=np.int32)[None, :]
+        pos = np.minimum(pos, self.cfg.max_position - 1)
+        pages = np.zeros((1, pb), np.int32)
+        pages[0, :len(req.pages)] = req.pages
+        feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
+                sv_model.PAGES_FEED: pages,
+                sv_model.LEN_FEED: np.asarray([n], np.int32)}
+        (nxt,) = self._exe.run(self._prefill_prog, feed=feed,
+                               fetch_list=[self._prefill_io["next_token"]],
+                               scope=self._scope)
+        req.state = RUNNING
+        self._running.append(req)
+        self.stats["prefills"] += 1
+        self.stats["prefill_signatures"].add((sb, pb))
+        self._accept_token(req, int(np.asarray(nxt).reshape(-1)[0]))
+
+    def _accept_token(self, req: GenRequest, tok: int) -> None:
+        req.all_tokens.append(tok)
+        now = time.perf_counter()
+        if req.t_first_token is None:
+            req.t_first_token = now
+        if req.is_done() or len(req.all_tokens) >= self.cfg.max_position:
+            if req in self._running:
+                self._running.remove(req)
+            self._release(req)
+            req.state = FINISHED
+            req.t_done = now
+
+    def _ensure_pages(self) -> None:
+        """Every running request must own the page its next slot lands in;
+        on pool exhaustion preempt the youngest (recompute-style)."""
+        for req in list(self._running):
+            if req.state != RUNNING:
+                continue
+            while req.cache_len // self.page_size >= len(req.pages):
+                got = self.pool.allocate(1)
+                if got is not None:
+                    req.pages.extend(got)
+                    continue
+                victim = max(self._running, key=lambda r: r.admit_seq)
+                if victim is req and len(self._running) == 1:
+                    raise RuntimeError(
+                        f"request {req.rid} needs page "
+                        f"{len(req.pages) + 1} but the pool "
+                        f"({self.pool.num_pages} pages) is exhausted with "
+                        f"nothing left to preempt")
+                self._preempt(victim)
+                if victim is req:
+                    break
+
+    def _preempt(self, req: GenRequest) -> None:
+        self._running.remove(req)
+        self._release(req)
+        req.state = WAITING
+        req.preemptions += 1
+        self.stats["preemptions"] += 1
+        # head of the waiting queue: a preempted request lost work, so it
+        # outranks new arrivals under fcfs
+        self._waiting.insert(0, req)
+
+    def _decode_once(self) -> bool:
+        self._ensure_pages()
+        rows = [r for r in self._running if r.state == RUNNING]
+        if not rows:
+            return False
+        bb = min(_round_up_pow2(len(rows)), _round_up_pow2(self.max_inflight))
+        pb = _round_up_pow2(max(len(r.pages) for r in rows))
+        tok = np.zeros((bb, 1), np.int32)
+        pos = np.zeros((bb,), np.int32)
+        pages = np.zeros((bb, pb), np.int32)
+        mask = np.zeros((bb, 1), np.float32)
+        for i, r in enumerate(rows):
+            tok[i, 0] = r.all_tokens[-1]
+            pos[i] = r.cache_len
+            pages[i, :len(r.pages)] = r.pages
+            mask[i, 0] = 1.0
+        feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
+                sv_model.PAGES_FEED: pages, sv_model.MASK_FEED: mask}
+        (nxt,) = self._exe.run(self._decode_prog, feed=feed,
+                               fetch_list=[self._decode_io["next_token"]],
+                               scope=self._scope)
+        nxt = np.asarray(nxt).reshape(-1)
+        self.stats["decode_steps"] += 1
+        self.stats["decode_signatures"].add((bb, pb))
+        for i, r in enumerate(rows):
+            self.stats["decode_tokens"] += 1
+            self._accept_token(r, int(nxt[i]))
+        return True

@@ -1,0 +1,116 @@
+"""Paged KV-cache manager: fixed-size pages over a preallocated HBM pool.
+
+The serving problem this solves (ROADMAP item 1 / "Ragged Paged Attention",
+arXiv:2604.15464): a max-seq-len KV buffer per request wastes
+(max_len - actual_len) slots of HBM per request, which is what actually caps
+concurrent requests — not compute. Instead:
+
+  * the DEVICE side is one preallocated pool per layer,
+    [num_pages, page_size, num_heads, head_dim] for K and V each, living in
+    the serving scope as persistable vars the compiled prefill/decode steps
+    read AND write (the executor donates the buffers, so every append is an
+    in-place HBM scatter, never a reallocation);
+  * the HOST side (this module) is pure bookkeeping: a free-list of page
+    ids and a per-request page table (list of page ids). allocate/free are
+    O(pages moved); nothing here touches the device.
+
+Admission control is the caller's job (engine.py): `can_allocate` is the
+backpressure predicate — when the free list runs dry, new requests queue
+instead of OOMing the pool, and mid-decode growth preempts rather than
+corrupts.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["PagedKVPool", "pool_var_names", "create_device_pools",
+           "declare_pool_vars"]
+
+
+def pool_var_names(num_layers: int) -> list[tuple[str, str]]:
+    """The (K, V) pool var names per layer — the one spelling shared by the
+    program builders (model.py), the scope initializer, and tests."""
+    return [(f"kv_cache.k{i}", f"kv_cache.v{i}") for i in range(num_layers)]
+
+
+def declare_pool_vars(block, num_layers: int, num_pages: int, page_size: int,
+                      num_heads: int, head_dim: int, dtype: str = "float32"):
+    """Declare the pool vars in a program block (both the prefill and the
+    decode program must see them so the executor's def-use analysis
+    classifies them read-write and donates their buffers)."""
+    for kn, vn in pool_var_names(num_layers):
+        for name in (kn, vn):
+            block.create_var(name=name,
+                             shape=[num_pages, page_size, num_heads, head_dim],
+                             dtype=dtype, persistable=True,
+                             stop_gradient=True)
+
+
+def create_device_pools(scope, num_layers: int, num_pages: int,
+                        page_size: int, num_heads: int, head_dim: int,
+                        dtype: str = "float32") -> None:
+    """Preallocate the zeroed device pools into `scope` (once, at engine
+    construction — this is the only allocation the cache ever does)."""
+    for kn, vn in pool_var_names(num_layers):
+        for name in (kn, vn):
+            scope.set_var(name, jnp.zeros(
+                (num_pages, page_size, num_heads, head_dim),
+                jnp.dtype(dtype)))
+
+
+class PagedKVPool:
+    """Free-list allocator over `num_pages` page ids.
+
+    Deliberately not thread-safe: the continuous-batching engine owns it
+    from one scheduler thread (the compiled steps carry the parallelism).
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError(
+                f"pool needs positive pages/page_size, got {num_pages}/"
+                f"{page_size} (FLAGS_serving_pool_pages / "
+                f"FLAGS_serving_page_size)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: recently-freed pages are re-used first, keeping
+        # the pool's hot working set small
+        self._free: list[int] = list(range(self.num_pages - 1, -1, -1))
+
+    # -- sizing ---------------------------------------------------------------
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages a context of `n_tokens` slots needs (ceil)."""
+        return max(1, -(-int(n_tokens) // self.page_size))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.pages_in_use / self.num_pages
+
+    # -- allocation -----------------------------------------------------------
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> list[int] | None:
+        """Pop `n` page ids, or None (backpressure — never a partial grab,
+        so a failed admission leaves the pool exactly as it found it)."""
+        if n > len(self._free):
+            return None
+        got = self._free[-n:]
+        del self._free[-n:]
+        return got
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not (0 <= p < self.num_pages):
+                raise ValueError(f"freeing page {p} outside pool "
+                                 f"[0, {self.num_pages})")
+            if p in self._free:
+                raise ValueError(f"double-free of page {p}")
+        self._free.extend(pages)

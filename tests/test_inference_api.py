@@ -51,6 +51,53 @@ def test_analysis_predictor_bf16(tmp_path):
         np.asarray(out, np.float32), ref, rtol=0.05, atol=0.02)
 
 
+def test_clone_shares_compile_cache(tmp_path):
+    """Predictor.clone must NOT re-wrap/recompile the program: the clone's
+    first run over an already-compiled signature is a cache hit (the old
+    clone paid a full XLA compile per clone)."""
+    from paddle_tpu.pipeline import jit_compile_counter
+
+    model_dir, xb, ref = _save_model(tmp_path)
+    pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    with jit_compile_counter() as c1:
+        pred.run_dict({"x": xb})
+    assert c1.count == 1
+    clone = pred.clone()
+    with jit_compile_counter() as c2:
+        out = clone.run_dict({"x": xb})
+    assert c2.count == 0, "clone recompiled an already-compiled signature"
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+
+
+def test_clone_runs_from_second_thread(tmp_path):
+    """A cloned predictor serving from a second thread while the parent
+    serves from the main thread: every result exact, no scope-stack
+    corruption (run_dict must not touch the global scope stack)."""
+    import threading
+
+    model_dir, xb, ref = _save_model(tmp_path)
+    pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    clone = pred.clone()
+    errors = []
+
+    def worker(p):
+        try:
+            for _ in range(20):
+                (out,) = p.run_dict({"x": xb})
+                np.testing.assert_allclose(out, ref, rtol=1e-5)
+        except Exception as e:  # noqa: BLE001 — surfaced on the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in (pred, clone, clone)]
+    for t in threads:
+        t.start()
+    worker(pred)  # main thread participates too
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
 def test_predictor_missing_feed_raises(tmp_path):
     model_dir, xb, _ = _save_model(tmp_path)
     pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))

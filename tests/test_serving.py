@@ -1,0 +1,365 @@
+"""Serving runtime tests (ISSUE 7): paged KV cache, ragged paged decode
+attention (XLA reference + Pallas interpret kernel) equivalence against
+dense attention, continuous-batching scheduling (backpressure, preemption,
+abort reclamation), and the compile-once-per-bucket contract."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import tuning, unique_name
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.ops import attention_ops as ao
+from paddle_tpu.serving import (PagedKVPool, ServingEngine, decoder_tiny,
+                                build_full_forward_program)
+from paddle_tpu.serving import model as sv_model
+
+
+def _rand(shape, seed):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+def _scattered_pool(lens, ps, nh, dh, num_pages, seed=0):
+    """Contiguous per-row K/V plus its paged scatter: returns
+    (k_dense, v_dense, k_pool, v_pool, page_table)."""
+    import jax.numpy as jnp
+
+    B = len(lens)
+    S = max(lens)
+    P = max(-(-l // ps) for l in lens)
+    k = _rand((B, nh, S, dh), seed)
+    v = _rand((B, nh, S, dh), seed + 1)
+    rng = np.random.default_rng(seed + 2)
+    perm = iter(rng.permutation(num_pages))
+    pt_ = np.zeros((B, P), np.int32)
+    for b in range(B):
+        for p in range(-(-lens[b] // ps)):
+            pt_[b, p] = next(perm)
+    kp = jnp.zeros((num_pages, ps, nh, dh), jnp.float32)
+    vp = jnp.zeros((num_pages, ps, nh, dh), jnp.float32)
+    kp, vp = ao.kv_cache_prefill_write_fn(
+        kp, vp, jnp.asarray(k), jnp.asarray(v), jnp.asarray(pt_),
+        jnp.asarray(lens, np.int32))
+    return k, v, kp, vp, jnp.asarray(pt_)
+
+
+# -- op level: paged attention vs dense --------------------------------------
+
+def test_paged_attention_matches_dense_ragged_rows():
+    """XLA gather-based paged decode attention over a shuffled page table
+    == dense attention per row, at three different context lengths."""
+    import jax.numpy as jnp
+
+    ps, nh, dh = 4, 2, 8
+    lens = [5, 9, 1]
+    k, v, kp, vp, pt_ = _scattered_pool(lens, ps, nh, dh, num_pages=16)
+    q = _rand((3, nh, dh), 9)
+    out = ao._paged_attention_reference(
+        jnp.asarray(q), kp, vp, pt_, jnp.asarray(lens, np.int32),
+        sm_scale=dh ** -0.5)
+    for b, L_ in enumerate(lens):
+        ref = ao._reference_attention(
+            jnp.asarray(q[b:b + 1, :, None, :]),
+            jnp.asarray(k[b:b + 1, :, :L_]), jnp.asarray(v[b:b + 1, :, :L_]),
+            sm_scale=dh ** -0.5)
+        np.testing.assert_allclose(np.asarray(out)[b],
+                                   np.asarray(ref)[0, :, 0, :],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_pallas_matches_reference():
+    """The Pallas page-DMA kernel (interpret mode on the CPU mesh) ==
+    the XLA gather reference, ragged lengths included."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import paged_attention as ppa
+
+    ps, nh, dh = 4, 2, 8
+    lens = [7, 12, 3, 1]
+    _, _, kp, vp, pt_ = _scattered_pool(lens, ps, nh, dh, num_pages=16,
+                                        seed=3)
+    q = jnp.asarray(_rand((4, nh, dh), 4))
+    ref = ao._paged_attention_reference(q, kp, vp, pt_,
+                                        jnp.asarray(lens, np.int32),
+                                        sm_scale=dh ** -0.5)
+    old = ppa.INTERPRET
+    ppa.INTERPRET = True
+    try:
+        out = ppa.paged_decode_attention(q, kp, vp, pt_,
+                                         jnp.asarray(lens, np.int32),
+                                         sm_scale=dh ** -0.5)
+    finally:
+        ppa.INTERPRET = old
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kv_append_page_boundary_and_mask():
+    """Appends that land on a page boundary go to the next page's slot 0;
+    masked (padded) rows write nothing."""
+    import jax.numpy as jnp
+
+    ps, nh, dh = 4, 2, 8
+    kp = jnp.zeros((8, ps, nh, dh), jnp.float32)
+    vp = jnp.zeros((8, ps, nh, dh), jnp.float32)
+    pt_ = jnp.asarray([[5, 2], [3, 6]], np.int32)
+    k = jnp.asarray(_rand((2, nh, dh), 0))
+    v = jnp.asarray(_rand((2, nh, dh), 1))
+    # row 0 writes slot 3 (last of page 5); row 1 is masked out
+    live = jnp.asarray([[1.0], [0.0]], np.float32)
+    kp1, vp1 = ao.kv_cache_append_fn(kp, vp, k, v, pt_,
+                                     jnp.asarray([3, 3], np.int32), live)
+    np.testing.assert_allclose(np.asarray(kp1)[5, 3], np.asarray(k)[0])
+    assert np.all(np.asarray(kp1)[3] == 0), "masked row wrote to its page"
+    # row 0's next append (slot 4 == page boundary) lands in page 2 slot 0
+    kp2, _ = ao.kv_cache_append_fn(kp1, vp1, k, v, pt_,
+                                   jnp.asarray([4, 4], np.int32), live)
+    np.testing.assert_allclose(np.asarray(kp2)[2, 0], np.asarray(k)[0])
+    np.testing.assert_allclose(np.asarray(kp2)[5, 3], np.asarray(k)[0])
+
+
+def test_paged_backend_tuner_lever(tmp_path):
+    """A swept DB entry drives the decode-attention backend for its exact
+    (b, nh, 1, sk, dh) key; an un-runnable pallas verdict (off-TPU, no
+    interpreter) degrades to the reference at dispatch — numerics exact."""
+    import jax.numpy as jnp
+
+    snap = pt.flags.all_flags()
+    db_path = str(tmp_path / "db.json")
+    try:
+        pt.flags.set_flags({"tuning_mode": "consult", "tuning_db": db_path})
+        tuning.invalidate_db_cache()
+        ps, nh, dh = 4, 2, 8
+        lens = [6, 2]
+        _, _, kp, vp, pt_ = _scattered_pool(lens, ps, nh, dh, num_pages=8)
+        P = pt_.shape[1]
+        key = tuning.canonical_key(
+            "attention", tuning.attention_key(2, nh, 1, P * ps, dh, True),
+            "float32", tuning.device_kind())
+        db = tuning.TuningDB(db_path)
+        db.put(key, {"backend": "pallas_paged"}, source="swept")
+        db.save(db_path)
+        tuning.invalidate_db_cache()
+        backend, tier = ao.paged_attention_backend(2, nh, P * ps, dh,
+                                                   np.dtype("float32"),
+                                                   pool_shape=kp.shape)
+        assert (backend, tier) == ("pallas_paged", "db")
+        q = jnp.asarray(_rand((2, nh, dh), 5))
+        out = ao.paged_decode_attention_fn(q, kp, vp, pt_,
+                                           jnp.asarray(lens, np.int32),
+                                           sm_scale=dh ** -0.5)
+        ref = ao._paged_attention_reference(q, kp, vp, pt_,
+                                            jnp.asarray(lens, np.int32),
+                                            sm_scale=dh ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+    finally:
+        pt.flags.set_flags(snap)
+        tuning.invalidate_db_cache()
+
+
+def test_decode_candidate_upgrades_via_tune(tmp_path, monkeypatch):
+    """The PR 6 candidates workflow extended to decode attention: a
+    sq=1 candidate key recorded by a sweep-mode run is measured and
+    upgraded to a swept verdict by tools/tune.py."""
+    from paddle_tpu.ops.pallas_kernels import paged_attention as ppa
+    from tools import tune
+
+    monkeypatch.setattr(ppa, "INTERPRET", True)  # both arms runnable on CPU
+    pt.flags.set_flags({"serving_page_size": 8})
+    try:
+        db_path = str(tmp_path / "db.json")
+        db = tuning.TuningDB(db_path)
+        key = tuning.canonical_key(
+            "attention", tuning.attention_key(2, 2, 1, 16, 8, True),
+            "float32", tuning.device_kind())
+        db.put(key, {"backend": "xla"}, source="candidate")
+        tune.sweep_candidates(db, iters=1, passes=2, band=0.05)
+        entry = db.lookup(key)
+        assert entry["source"] == "swept"
+        assert entry["decision"]["backend"] in ("xla", "pallas_paged")
+        assert {"xla", "pallas_paged"} <= set(entry["measured"])
+    finally:
+        pt.flags.set_flags({"serving_page_size": 16})
+
+
+# -- pool allocator ----------------------------------------------------------
+
+def test_pool_allocator_edges():
+    pool = PagedKVPool(4, 8)
+    assert pool.pages_for(1) == 1 and pool.pages_for(8) == 1
+    assert pool.pages_for(9) == 2
+    got = pool.allocate(3)
+    assert len(got) == 3 and pool.free_count == 1
+    assert pool.allocate(2) is None, "partial grabs must not happen"
+    assert pool.free_count == 1
+    pool.free(got)
+    assert pool.free_count == 4
+    with pytest.raises(ValueError, match="double-free"):
+        pool.free([got[0], got[0]])
+    with pytest.raises(ValueError, match="outside pool"):
+        pool.free([99])
+
+
+# -- engine: equivalence against dense attention -----------------------------
+
+def test_engine_generation_matches_dense_oracle():
+    """The whole serving path (bucketed prefill -> paged ragged decode over
+    scattered pages, with requests of different lengths batched together)
+    greedy-generates EXACTLY what a dense full-context forward does."""
+    cfg = decoder_tiny()
+    eng = ServingEngine(cfg, page_size=4, pool_pages=64, max_inflight=4)
+    rng = np.random.default_rng(7)
+    prompts = [list(rng.integers(1, cfg.vocab_size, n)) for n in (3, 9, 17)]
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_drained()
+
+    full = Program()
+    with program_guard(full, Program()), unique_name.guard():
+        io = build_full_forward_program(cfg)
+    for p, rid in zip(prompts, rids):
+        seq = list(p)
+        for _ in range(6):
+            feed = {sv_model.TOK_FEED: np.asarray(seq, np.int32)[None, :],
+                    sv_model.POS_FEED:
+                        np.arange(len(seq), dtype=np.int32)[None, :]}
+            (lg,) = eng._exe.run(full, feed=feed,
+                                 fetch_list=[io["logits"]],
+                                 scope=eng._scope)
+            seq.append(int(np.argmax(lg[0, -1])))
+        assert eng.result(rid) == seq[len(p):], f"request {rid} diverged"
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
+# -- engine: scheduling edge cases -------------------------------------------
+
+def test_pool_exhaustion_backpressures_admission():
+    """More requests than the pool can hold at once: admission queues them
+    (never crashes, never oversubscribes) and every request still
+    finishes once earlier ones release pages."""
+    cfg = decoder_tiny()
+    # 6 pages of 4 slots: one 9-token prompt + decode needs 3 pages, so at
+    # most two requests fit concurrently
+    eng = ServingEngine(cfg, page_size=4, pool_pages=6, max_inflight=8)
+    rng = np.random.default_rng(1)
+    rids = [eng.submit(list(rng.integers(1, cfg.vocab_size, 9)),
+                       max_new_tokens=3) for _ in range(5)]
+    eng.run_until_drained()
+    assert all(eng.requests[r].state == "finished" for r in rids)
+    assert eng.stats["peak_pages_in_use"] <= eng.pool.num_pages
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
+def test_oversize_request_raises_cleanly():
+    cfg = decoder_tiny()
+    eng = ServingEngine(cfg, page_size=4, pool_pages=2, max_inflight=2)
+    with pytest.raises(ValueError, match="max_position"):
+        eng.submit(list(range(1, 80)), max_new_tokens=60)
+    # fits max_position but can never fit the 2-page pool: surfaced, not hung
+    eng.submit(list(np.random.default_rng(0).integers(1, 97, 20)),
+               max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="pool"):
+        eng.run_until_drained()
+
+
+def test_preemption_recomputes_exactly():
+    """Mid-decode pool exhaustion preempts the youngest request; its
+    re-prefilled continuation produces the SAME tokens a pressure-free pool
+    yields (greedy decode + recompute preemption is exact)."""
+    cfg = decoder_tiny()
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(1, cfg.vocab_size, n)) for n in (7, 7)]
+
+    big = ServingEngine(cfg, page_size=2, pool_pages=64, max_inflight=2)
+    want = []
+    for p in prompts:
+        rid = big.submit(p, max_new_tokens=8)
+        big.run_until_drained()
+        want.append(big.result(rid))
+
+    # 9 pages of 2 slots: both requests admit (4 pages each for 7+1 slots),
+    # but growing to 15 slots each needs 16 pages total -> preemption
+    small = ServingEngine(cfg, page_size=2, pool_pages=9, max_inflight=2)
+    rids = [small.submit(p, max_new_tokens=8) for p in prompts]
+    small.run_until_drained()
+    assert small.stats["preemptions"] >= 1, "pool pressure never triggered"
+    assert [small.result(r) for r in rids] == want
+    assert small.pool.free_count == small.pool.num_pages
+
+
+def test_sjf_policy_admits_shortest_first():
+    cfg = decoder_tiny()
+    eng = ServingEngine(cfg, page_size=4, pool_pages=64, max_inflight=1,
+                        policy="sjf")
+    rng = np.random.default_rng(5)
+    long_rid = eng.submit(list(rng.integers(1, 97, 20)), max_new_tokens=2)
+    short_rid = eng.submit(list(rng.integers(1, 97, 3)), max_new_tokens=2)
+    eng.step()  # max_inflight=1: exactly one admission — sjf picks short
+    assert eng.requests[short_rid].state in ("running", "finished")
+    assert eng.requests[long_rid].state == "waiting"
+    eng.run_until_drained()
+    assert eng.requests[long_rid].state == "finished"
+
+
+# -- compile discipline ------------------------------------------------------
+
+def test_decode_compiles_once_per_bucket():
+    """The compile-count contract (reusing the PR 2 jit_compile_counter
+    hook): a full run compiles decode exactly once per (batch-bucket,
+    page-bucket) signature, and a second identical wave through the same
+    engine compiles NOTHING."""
+    from paddle_tpu.pipeline import jit_compile_counter
+
+    cfg = decoder_tiny()
+    eng = ServingEngine(cfg, page_size=4, pool_pages=64, max_inflight=4)
+    rng = np.random.default_rng(11)
+
+    def wave():
+        rids = [eng.submit(list(rng.integers(1, 97, n)), max_new_tokens=4)
+                for n in (3, 5, 9, 12)]
+        eng.run_until_drained()
+        return rids
+
+    with jit_compile_counter() as c1:
+        wave()
+    n_sigs = (len(eng.stats["prefill_signatures"])
+              + len(eng.stats["decode_signatures"]))
+    assert c1.count == n_sigs, (
+        f"{c1.count} XLA compiles for {n_sigs} distinct bucket signatures "
+        f"(prefill {eng.stats['prefill_signatures']}, decode "
+        f"{eng.stats['decode_signatures']})")
+    with jit_compile_counter() as c2:
+        wave()
+    assert c2.count == 0, (
+        f"second wave recompiled {c2.count}x — bucketing failed to hit "
+        f"the compile cache")
+
+
+# -- chaos: aborted requests leak nothing ------------------------------------
+
+@pytest.mark.chaos
+def test_abort_mid_decode_returns_pages_over_cycles():
+    """`serving_abort` fault site: requests aborted mid-decode across
+    several cycles; after every drain the free list holds the WHOLE pool
+    (zero leaked pages), and aborted requests are properly terminal."""
+    from paddle_tpu.resilience.faults import fault_scope
+
+    cfg = decoder_tiny()
+    eng = ServingEngine(cfg, page_size=4, pool_pages=32, max_inflight=4)
+    rng = np.random.default_rng(13)
+    total_aborts = 0
+    for cycle in range(3):
+        with fault_scope("serving_abort:2,4") as plan:
+            rids = [eng.submit(list(rng.integers(1, 97, n)),
+                               max_new_tokens=6) for n in (4, 9, 14)]
+            eng.run_until_drained()
+            assert plan.stats()["fired"], "abort plan never fired"
+        states = {eng.requests[r].state for r in rids}
+        assert states <= {"finished", "aborted"}
+        assert "aborted" in states, f"cycle {cycle}: nothing was aborted"
+        total_aborts += sum(1 for r in rids
+                            if eng.requests[r].state == "aborted")
+        assert eng.pool.free_count == eng.pool.num_pages, (
+            f"cycle {cycle} leaked "
+            f"{eng.pool.num_pages - eng.pool.free_count} pages")
+    assert eng.stats["aborts"] == total_aborts

@@ -1,0 +1,146 @@
+"""Open-loop served-load driver for the serving runtime (ISSUE 7).
+
+Open-loop means arrivals do NOT wait for the system: request i arrives at
+its scheduled offset (exponential inter-arrival at `rate` req/s) whether or
+not the engine is keeping up — the only honest load model for "heavy
+traffic from millions of users" (a closed loop self-throttles and hides
+queueing collapse). Per-request stamps (arrival, first token, completion)
+feed the shared tools/_timing.py percentile protocol, so p50/p99 here and
+in the bench.py `serving` block are the same arithmetic.
+
+    python tools/_serve_ab.py                       # default rate sweep
+    python tools/_serve_ab.py --rates 4,16,64 --requests 64
+    python tools/_serve_ab.py --pool-pages 64       # pressure the pool
+
+Each rate prints one JSON line; the last line is the sweep summary.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from tools import _timing  # noqa: E402
+
+
+def synth_workload(n_requests: int, vocab_size: int, seed: int,
+                   prompt_lens=(4, 24), max_new: int = 8,
+                   rate: float = 8.0) -> list:
+    """[(arrival_offset_s, prompt, max_new)] — seeded, so a rate's workload
+    replays identically across runs/arms."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    lo, hi = prompt_lens
+    out = []
+    for i in range(n_requests):
+        plen = int(rng.integers(lo, hi + 1))
+        prompt = rng.integers(1, vocab_size, plen).tolist()
+        out.append((float(arrivals[i]), prompt, int(max_new)))
+    return out
+
+
+def run_open_loop(engine, workload, max_steps: int = 200_000) -> dict:
+    """Drive one engine through one workload; returns the serving metrics
+    block (served tokens/s, p50/p99 request + first-token latency, pool
+    occupancy, and the zero-leak page count)."""
+    pending = deque(sorted(workload))
+    rids = []
+    t0 = time.perf_counter()
+    steps = 0
+    while pending or engine.has_work():
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, prompt, max_new = pending.popleft()
+            rids.append(engine.submit(prompt, max_new))
+        if engine.has_work():
+            engine.step()
+        elif pending:
+            time.sleep(min(0.002, max(0.0, pending[0][0] - now)))
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(f"open loop did not drain in {max_steps} "
+                               f"iterations")
+    wall = time.perf_counter() - t0
+
+    reqs = [engine.requests[r] for r in rids]
+    done = [r for r in reqs if r.state == "finished"]
+    lat = [r.t_done - r.arrival_t for r in done]
+    ttft = [r.t_first_token - r.arrival_t for r in done
+            if r.t_first_token is not None]
+    served_tokens = sum(r.n_generated for r in done)
+    st = engine.stats
+    occ_mean = (st["occupancy_sum"] / st["occupancy_n"]
+                if st["occupancy_n"] else 0.0)
+    return {
+        "requests": len(reqs),
+        "finished": len(done),
+        "aborted": sum(1 for r in reqs if r.state == "aborted"),
+        "served_tokens": served_tokens,
+        "wall_s": round(wall, 4),
+        "served_tokens_per_sec": round(served_tokens / wall, 2) if wall else 0.0,
+        "request_latency": _timing.latency_stats(lat),
+        "first_token_latency": _timing.latency_stats(ttft),
+        "kv_pool_occupancy_mean": round(occ_mean, 4),
+        "kv_pool_occupancy_peak": round(
+            st["peak_pages_in_use"] / engine.pool.num_pages, 4),
+        "kv_pages_leaked": engine.pool.num_pages - engine.pool.free_count,
+        "decode_steps": st["decode_steps"],
+        "prefills": st["prefills"],
+        "preemptions": st["preemptions"],
+        "decode_compile_buckets": len(st["decode_signatures"]),
+        "prefill_compile_buckets": len(st["prefill_signatures"]),
+    }
+
+
+def main():
+    from paddle_tpu.serving import DecoderConfig, ServingEngine, decoder_tiny
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rates", default="4,16,64" if on_tpu else "8,32",
+                    help="comma list of arrival rates (req/s)")
+    ap.add_argument("--requests", type=int, default=64 if on_tpu else 16)
+    ap.add_argument("--max-new", type=int, default=32 if on_tpu else 6)
+    ap.add_argument("--page-size", type=int, default=None)
+    ap.add_argument("--pool-pages", type=int, default=None)
+    ap.add_argument("--max-inflight", type=int, default=None)
+    ap.add_argument("--policy", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if on_tpu:
+        cfg = DecoderConfig(vocab_size=30522, hidden_size=512, num_layers=6,
+                            num_heads=8, ffn_size=2048, max_position=1024)
+        prompt_lens = (16, 128)
+    else:
+        cfg = decoder_tiny()
+        prompt_lens = (4, 24)
+
+    summary = {}
+    for rate in [float(r) for r in args.rates.split(",") if r]:
+        engine = ServingEngine(cfg, page_size=args.page_size,
+                               pool_pages=args.pool_pages,
+                               max_inflight=args.max_inflight,
+                               policy=args.policy, seed=args.seed)
+        wl = synth_workload(args.requests, cfg.vocab_size, args.seed,
+                            prompt_lens=prompt_lens, max_new=args.max_new,
+                            rate=rate)
+        out = run_open_loop(engine, wl)
+        out["rate_req_s"] = rate
+        print(json.dumps(out), flush=True)
+        summary[str(rate)] = out["served_tokens_per_sec"]
+    print(json.dumps({"sweep": "serve_ab", "served_tok_s_by_rate": summary}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

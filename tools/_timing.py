@@ -22,7 +22,8 @@ import time
 import numpy as np
 
 __all__ = ["timed_windows", "time_call", "median", "interference_band",
-           "measure", "ab_verdict", "DEFAULT_BAND"]
+           "measure", "ab_verdict", "DEFAULT_BAND", "percentile",
+           "latency_stats"]
 
 # gate.py's interference band: margins inside it are machine noise, not a
 # measured win (PERF.md r4 — single bursts on the shared box outlast a
@@ -81,6 +82,28 @@ def measure(run_once, drain, iters: int, passes: int,
         "min_s": float(min(windows)),
         "windows_s": [round(w, 6) for w in windows],
         "band": round(interference_band(windows), 4),
+    }
+
+
+def percentile(xs, q: float) -> float:
+    """Linear-interpolated percentile (q in [0, 100])."""
+    return float(np.percentile(np.asarray(list(xs), dtype=np.float64), q))
+
+
+def latency_stats(seconds) -> dict:
+    """Per-request latency summary for the serving load harnesses
+    (tools/_serve_ab.py, the bench.py `serving` block): p50/p99 are THE
+    serving SLO spellings, mean/max ride along for forensics. All ms."""
+    xs = [float(s) for s in seconds]
+    if not xs:
+        return {"n": 0, "p50_ms": None, "p99_ms": None, "mean_ms": None,
+                "max_ms": None}
+    return {
+        "n": len(xs),
+        "p50_ms": round(1e3 * percentile(xs, 50), 3),
+        "p99_ms": round(1e3 * percentile(xs, 99), 3),
+        "mean_ms": round(1e3 * float(np.mean(xs)), 3),
+        "max_ms": round(1e3 * max(xs), 3),
     }
 
 

@@ -44,6 +44,13 @@ RESNET_VS_TARGET_DROP = 0.95
 # tools/tune.py) or keyed for another device (ISSUE 6 acceptance line)
 TUNER_HIT_RATE_FLOOR = 0.5
 
+# serving runtime (ISSUE 7): flag an artifact whose open-loop served
+# tokens/s falls more than this factor below the previous round's — the
+# open-loop workload is seeded/identical every round, so a drop this size
+# is a scheduler/kernel regression, not arrival noise. Leaked KV pages are
+# a hard fail at any count: the pool never reclaims them.
+SERVING_TOK_S_DROP = 0.8
+
 
 def run_suite() -> int:
     print("[gate] running test suite ...", flush=True)
@@ -161,6 +168,47 @@ def _check_tuner_coverage(data: dict, label: str) -> int:
     return rc
 
 
+def _check_serving(data: dict, prev_path: str | None, label: str) -> int:
+    """Serving-block gate (ISSUE 7): zero KV-page leak is a hard invariant;
+    served tokens/s may not drop below SERVING_TOK_S_DROP of the previous
+    artifact's (both artifacts must carry the block — pre-serving rounds
+    are skipped)."""
+    sv = data.get("serving")
+    if not isinstance(sv, dict):
+        return 0
+    leaked = sv.get("kv_pages_leaked")
+    cur = sv.get("served_tokens_per_sec")
+    lat = sv.get("request_latency") or {}
+    print(f"[gate] bench {label}: serving {cur} tok/s, p50 "
+          f"{lat.get('p50_ms')} ms, p99 {lat.get('p99_ms')} ms, occupancy "
+          f"peak {sv.get('kv_pool_occupancy_peak')}, leaked pages {leaked}",
+          flush=True)
+    if leaked:
+        print(f"[gate] FAIL: the KV pool leaked {leaked} pages after the "
+              f"open-loop run drained — a request path (finish/abort/"
+              f"preempt) is not returning pages to the free list",
+              flush=True)
+        return 1
+    if cur is None or prev_path is None:
+        return 0
+    try:
+        with open(prev_path) as f:
+            prev = _bench_metrics(f.read())
+    except (OSError, ValueError):
+        return 0
+    prev_v = ((prev or {}).get("serving") or {}).get("served_tokens_per_sec")
+    if prev_v is None:
+        return 0
+    if cur < SERVING_TOK_S_DROP * prev_v:
+        print(f"[gate] FAIL: served tokens/s regressed {prev_v} -> {cur} "
+              f"(> {100 * (1 - SERVING_TOK_S_DROP):.0f}% drop on the seeded "
+              f"open-loop workload) — check decode_compile_buckets and "
+              f"preemptions before blaming the attention kernel",
+              flush=True)
+        return 1
+    return 0
+
+
 def check_bench(path: str | None = None) -> int:
     """Flag a DeepFM end-to-end/device-path regression in the bench artifact.
 
@@ -189,6 +237,8 @@ def check_bench(path: str | None = None) -> int:
     if _check_resnet_regression(data, prev_path, os.path.basename(path)):
         return 1
     if _check_tuner_coverage(data, os.path.basename(path)):
+        return 1
+    if _check_serving(data, prev_path, os.path.basename(path)):
         return 1
     ratio = data.get("deepfm_e2e_device_ratio")
     if ratio is None:

@@ -71,6 +71,16 @@ ATTENTION_SHAPES = [
     ("bert_s512", 64, 12, 512, 64, False),
 ]
 
+# serving decode regimes (sq=1, long ragged sk): the shapes the serving
+# engine's paged-attention lever keys on — (batch-bucket, heads,
+# padded-slot-count, head_dim). The ragged kv_lens inside each arm span
+# 1/4..full so the sweep times realistic occupancy, not the dense corner.
+DECODE_ATTENTION_SHAPES = [
+    ("decode_b8_kv1024", 8, 12, 1024, 64),
+    ("decode_b32_kv512", 32, 12, 512, 64),
+    ("decode_b64_kv2048", 64, 12, 2048, 64),
+]
+
 
 def _out_hw(h, w, kh, kw, strides, pads, d):
     hout = (h + sum(pads[0]) - ((kh - 1) * d[0] + 1)) // strides[0] + 1
@@ -220,16 +230,101 @@ def sweep_attention(db, shapes, dtype: str, iters: int, passes: int,
                           "verdict": verdict}), flush=True)
 
 
+def sweep_decode_attention(db, shapes, dtype: str, iters: int, passes: int,
+                           band: float):
+    """The serving lever's sweep: XLA gather-based paged attention vs the
+    Pallas page-DMA kernel per (batch, heads, kv_slots, head_dim) decode
+    shape. Keys are attention_key(b, nh, 1, kv, dh, causal=1) — exactly
+    what ops/attention_ops.paged_attention_backend consults, so a swept
+    verdict here IS the serving engine's dispatch for that bucket."""
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu.ops.attention_ops import (_paged_attention_reference,
+                                              _pallas_paged_ok)
+
+    key_dtype = str(jnp.dtype(dtype))
+    ps = int(pt_flags.get_flag("serving_page_size"))
+    for name, b, nh, kv, dh in shapes:
+        kv = max(ps, (kv // ps) * ps)  # whole pages
+        num_pages = b * (kv // ps) + 1
+        rng = np.random.default_rng(0)
+        kp, vp = (jax.device_put(rng.standard_normal(
+            (num_pages, ps, nh, dh), dtype=np.float32).astype(dtype))
+            for _ in range(2))
+        q = jax.device_put(rng.standard_normal(
+            (b, nh, dh), dtype=np.float32).astype(dtype))
+        pt_ = jax.device_put(rng.permutation(num_pages - 1)[:b * (kv // ps)]
+                             .reshape(b, kv // ps).astype(np.int32))
+        kv_lens = jax.device_put(
+            rng.integers(max(1, kv // 4), kv + 1, b).astype(np.int32))
+        sm = dh ** -0.5
+
+        arms = {"xla": lambda: jax.jit(_paged_attention_reference)(
+            q, kp, vp, pt_, kv_lens, sm)}
+        if _pallas_paged_ok(q.shape, kp.shape):
+            from paddle_tpu.ops.pallas_kernels import paged_attention as ppa
+
+            arms["pallas_paged"] = lambda: ppa.paged_decode_attention(
+                q, kp, vp, pt_, kv_lens, sm_scale=sm)
+        print(json.dumps({"sweep": "decode_attention", "shape": name,
+                          "arms": sorted(arms)}), flush=True)
+        if len(arms) < 2:
+            print(json.dumps({"shape": name, "skipped":
+                              "only the XLA arm runs on this platform"}),
+                  flush=True)
+            continue
+        measured = _measure_arms(arms, iters, passes)
+        backend, verdict = _verdict_vs_base(measured, "xla", band)
+        key = tuning.canonical_key(
+            "attention", tuning.attention_key(b, nh, 1, kv, dh, True),
+            key_dtype, tuning.device_kind())
+        db.put(key, {"backend": backend}, source="swept",
+               measured={a: {"median_s": m["median_s"], "band": m["band"]}
+                         for a, m in measured.items()},
+               note=f"{name}: verdict={verdict}")
+        print(json.dumps({"shape": name, "decision": backend,
+                          "verdict": verdict}), flush=True)
+
+
 _CONV_KEY_RE = re.compile(
     r"^conv2d\|n=(\d+) out=(\d+)x(\d+) cin=(\d+) cout=(\d+) k=(\d+)x(\d+) "
     r"s=(\d+)x(\d+) d=(\d+)x(\d+) (NHWC|NCHW)\|([\w.]+)\|")
 
 
+_ATTN_KEY_RE = re.compile(
+    r"^attention\|b=(\d+) nh=(\d+) sq=(\d+) sk=(\d+) dh=(\d+) "
+    r"causal=(\d)\|([\w.]+)\|")
+
+
 def sweep_candidates(db, iters, passes, band):
-    """Upgrade `candidate` conv2d entries (recorded by a
-    FLAGS_tuning_mode=sweep run) to measured verdicts. The input extent is
-    reconstructed pad-free from the output tile — the GEMM dims (M, folded
-    K) that drive the decision are identical either way."""
+    """Upgrade `candidate` entries (recorded by a FLAGS_tuning_mode=sweep
+    run) to measured verdicts — conv2d lowerings AND attention backends.
+    Attention candidates route by shape: sq=1 keys are serving decode
+    dispatches (ragged paged attention), sq==sk keys are the encoder
+    self-attention regimes; anything else is skipped (no harness measures
+    it honestly). Conv input extents are reconstructed pad-free from the
+    output tile — the GEMM dims (M, folded K) that drive the decision are
+    identical either way."""
+    attn_groups: dict[str, list] = {}
+    decode_groups: dict[str, list] = {}
+    for ckey, entry in sorted(db.entries.items()):
+        if entry.get("source") != "candidate":
+            continue
+        am = _ATTN_KEY_RE.match(ckey)
+        if am:
+            b, nh, sq, sk, dh_, causal = map(int, am.groups()[:6])
+            dt = am.group(7)
+            if sq == 1:
+                decode_groups.setdefault(dt, []).append(
+                    (f"candidate_b{b}_kv{sk}", b, nh, sk, dh_))
+            elif sq == sk:
+                attn_groups.setdefault(dt, []).append(
+                    (f"candidate_b{b}_s{sq}", b, nh, sq, dh_, bool(causal)))
+            continue
+    for dt, shapes in sorted(attn_groups.items()):
+        sweep_attention(db, shapes, dt, iters, passes, band)
+    for dt, shapes in sorted(decode_groups.items()):
+        sweep_decode_attention(db, shapes, dt, iters, passes, band)
+
     rows = []
     for ckey, entry in sorted(db.entries.items()):
         if entry.get("source") != "candidate":
@@ -273,12 +368,15 @@ def main():
 
     conv_shapes = RN50_CONV_SHAPES
     attn_shapes = ATTENTION_SHAPES
+    decode_shapes = DECODE_ATTENTION_SHAPES
     if args.small or not on_tpu:
         conv_shapes = [(nm, 8, h // 4, w // 4, ci, co, kh, kw, st, pd, d)
                        for nm, _, h, w, ci, co, kh, kw, st, pd, d
                        in RN50_CONV_SHAPES]
         attn_shapes = [(nm, 2, nh, s, dh, c)
                        for nm, _, nh, s, dh, c in ATTENTION_SHAPES]
+        decode_shapes = [(nm, 2, nh, kv // 4, dh)
+                         for nm, _, nh, kv, dh in DECODE_ATTENTION_SHAPES]
 
     db = tuning.TuningDB(args.db)
     what = {w.strip() for w in args.what.split(",") if w.strip()}
@@ -288,6 +386,10 @@ def main():
     if "attention" in what:
         sweep_attention(db, attn_shapes, args.dtype, args.iters,
                         args.passes, args.band)
+        # the serving lever's decode regimes ride the attention sweep: same
+        # op kind, same DB namespace, different (sq=1) shape family
+        sweep_decode_attention(db, decode_shapes, args.dtype, args.iters,
+                               args.passes, args.band)
     if "candidates" in what:
         sweep_candidates(db, args.iters, args.passes, args.band)
     db.save(args.db)
