@@ -1,5 +1,10 @@
 """Benchmark: training-step throughput on one chip, all BASELINE workloads.
 
+`--multichip` instead runs the measured multichip scaling campaign
+(tools/_mc_ab.py: per-axis dp/tp/pp/sp tokens/s + scaling efficiency with
+collective-overlap A/B arms on an 8-device mesh) and prints its artifact
+line; see bench_multichip.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 vs_baseline = MIN over every measured workload's vs_target (BERT / RN50 /
 WMT MFU each against the 0.45 north star, DeepFM examples/s against the
@@ -513,6 +518,44 @@ def _tuned(tuner_stats: dict, name: str, fn, *args):
     return out
 
 
+def bench_multichip(argv=None):
+    """`bench.py --multichip`: the measured multichip scaling campaign
+    (ROADMAP item 2 promoted from dryrun) — tokens/s and per-axis scaling
+    efficiency for dp/tp/pp/sp on an 8-device mesh, with collective-overlap
+    A/B arms (bucketed vs per-grad allreduce, ZeRO-1, 1F1B vs fill-drain)
+    on the tools/_timing.py protocol, plus the parameter-trajectory parity
+    oracle per axis. Prints ONE JSON line (the MULTICHIP artifact's
+    scaling/overlap_ab/parity blocks; tools/gate.py --multichip consumes
+    it). Off-TPU the campaign provisions a virtual 8-device CPU mesh in a
+    fresh process — platform choice is locked at first backend init, so a
+    session that already initialized fewer devices re-execs."""
+    import os
+    import subprocess
+    import sys
+
+    argv = list(argv or [])
+    n = 8
+    if "--devices" in argv:
+        n = int(argv[argv.index("--devices") + 1])
+    import jax
+
+    if len(jax.devices()) < n and jax.devices()[0].platform != "tpu":
+        repo = os.path.dirname(os.path.abspath(__file__))
+        from __graft_entry__ import _FORCE_ENV
+
+        env = dict(os.environ)
+        env[_FORCE_ENV] = str(n)
+        code = (f"import sys; sys.path.insert(0, {repo!r}); "
+                f"import __graft_entry__ as g; g._provision_cpu_mesh({n}); "
+                f"from tools import _mc_ab; "
+                f"sys.exit(_mc_ab.main({argv!r}))")
+        r = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env)
+        return r.returncode
+    from tools import _mc_ab
+
+    return _mc_ab.main(argv)
+
+
 def main():
     from paddle_tpu import flags as pt_flags
     from paddle_tpu import tuning
@@ -616,4 +659,9 @@ def main():
 
 
 if __name__ == "__main__":
+    import sys as _sys
+
+    if "--multichip" in _sys.argv:
+        _argv = [a for a in _sys.argv[1:] if a != "--multichip"]
+        _sys.exit(bench_multichip(_argv))
     main()

@@ -13,7 +13,24 @@ from __future__ import annotations
 from .framework import Program, Variable, grad_var_name
 from .ops.registry import default_grad_maker, get_op_def
 
-__all__ = ["append_backward", "gradients"]
+__all__ = ["append_backward", "gradients", "grad_ready_index"]
+
+
+def grad_ready_index(block, grad_name: str, before: int) -> int:
+    """Index of the LAST op writing `grad_name` strictly below op `before`.
+
+    This is the earliest program point where a gradient is final and may be
+    bucketed onto a collective (parallel/collective.py): "last writer"
+    rather than "grad-op producer" because AMP's unscale/check ops, clip,
+    regularizers and the guardrail sentinel all rewrite gradients in place
+    AFTER the raw grad op — a reduce inserted above any of them would ship
+    a stale value. Returns -1 when nothing below `before` writes the name
+    (the caller falls back to inserting at `before`)."""
+    last = -1
+    for i in range(min(before, len(block.ops))):
+        if grad_name in block.ops[i].output_names:
+            last = i
+    return last
 
 
 def _find_op_path(block, target_names) -> list[int]:

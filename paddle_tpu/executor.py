@@ -555,7 +555,9 @@ class Executor:
                 # keep the batch around until its (window-delayed) health
                 # verdict lands — the blame replay needs the poison feed
                 self._step_guard.note_dispatch(self._dispatch_seq, feed)
-            self._inflight.append((self._dispatch_seq, token, health))
+            self._inflight.append(
+                (self._dispatch_seq, token, health,
+                 getattr(self, "_last_spmd_mode", "gspmd")))
             window = int(flags.get_flag("max_inflight_steps"))
             if window > 0:
                 while len(self._inflight) > window:
@@ -582,10 +584,16 @@ class Executor:
         from .resilience.faults import InjectedFault, fault_point
         from .resilience.watchdog import Watchdog, runtime_state
 
-        step_id, token, health = self._inflight[0]
+        step_id, token, health, spmd_mode = self._inflight[0]
         stalled = False
         try:
             fault_point("pipeline_stall")
+            if spmd_mode == "shard_map":
+                # a collective program's completion token resolves only when
+                # every rank's psum/gather lands — a lost/hung partner wedges
+                # exactly here. The site lets chaos drills prove the watchdog
+                # surfaces a hung allreduce with step ids + queue depths.
+                fault_point("collective_stall")
         except InjectedFault:
             stalled = True  # behave as if the device never completes
         wd = Watchdog()
@@ -596,13 +604,17 @@ class Executor:
             def state():
                 return runtime_state(
                     oldest_step=step_id,
-                    inflight_step_ids=[s for s, _, _ in self._inflight],
+                    inflight_step_ids=[e[0] for e in self._inflight],
                     inflight_depth=len(self._inflight),
+                    spmd_mode=spmd_mode,
                     max_inflight_steps=int(
                         flags.get_flag("max_inflight_steps")))
 
+            what = (f"Executor async step {step_id}"
+                    if spmd_mode != "shard_map" else
+                    f"Executor async step {step_id} (collective allreduce)")
             wd.wait((lambda: False) if stalled else is_ready, state,
-                    what=f"Executor async step {step_id}")
+                    what=what)
         self._inflight.popleft()
         if health is not None and self._step_guard is not None:
             # token resolved above, so this 4-float read never blocks on
@@ -615,7 +627,7 @@ class Executor:
         (their state writes are about to be overwritten by the checkpoint
         restore), so their health verdicts must not re-trigger the guard."""
         while self._inflight:
-            _, token, _ = self._inflight.popleft()
+            token = self._inflight.popleft()[1]
             try:
                 jax.block_until_ready(token)
             except Exception:  # noqa: BLE001 — discard path
@@ -638,6 +650,10 @@ class Executor:
             mesh = program._mesh
             spmd_mode = program._spmd_mode
             program = program._program
+        # run_async tags each inflight entry with the regime it dispatched
+        # under, so the drain watchdog can attribute a wedge to a hung
+        # collective (the collective_stall fault site) vs a plain step
+        self._last_spmd_mode = spmd_mode
         if program is None:
             program = default_main_program()
         feed = feed or {}

@@ -102,6 +102,38 @@ _define("cpu_deterministic", False,
         "reference flags.cc:98)")
 _define("profiler_dir", "/tmp/paddle_tpu_profile",
         "default trace output directory for profiler.profiler()")
+# multichip collective-overlap knobs (parallel/collective.py, sharding.py,
+# pipeline.py — the measured scaling campaign, see README "Multichip")
+_define("allreduce_bucket_mb", 4.0,
+        "gradient-bucket size in MB for the collective (shard_map) regime: "
+        "GradAllReduce coalesces grads into reverse-topological buckets of "
+        "about this many megabytes and inserts each bucket's mean-allreduce "
+        "right where its last gradient is produced, so the reduce of "
+        "already-finished buckets overlaps the remaining backward compute "
+        "instead of serializing after it. <=0 restores the per-gradient "
+        "allreduce inserted before the optimizer ops (the overlap-off A/B "
+        "arm). Under FLAGS_tuning_mode=consult the size is resolved through "
+        "the tuning DB ('collective|mesh=..|payload=..' keys, this flag is "
+        "the analytic prior); tools/_mc_ab.py sweeps and records verdicts")
+_define("zero1", False,
+        "ZeRO-1 optimizer-state sharding for the collective regime "
+        "(parallel/sharding.py apply_zero1): each eligible gradient is "
+        "reduce-scattered over the data axis, the optimizer op updates only "
+        "this rank's 1/nranks shard of the parameter (and of its moment "
+        "accumulators), and the updated shards are allgathered back — the "
+        "gathers sit at the program tail so with FLAGS_max_inflight_steps>1 "
+        "they overlap the next step's first buckets. Parameters whose "
+        "leading dim does not divide by nranks fall back to the bucketed "
+        "allreduce path")
+_define("pipeline_schedule", "1f1b",
+        "default microbatch schedule for PipelineOptimizer / "
+        "build_pipeline_plan when none is passed explicitly: '1f1b' "
+        "(PipeDream-flush steady state — at most ~n_stages microbatches in "
+        "flight, boundary stash freed as each backward completes) or "
+        "'gpipe' (naive fill-drain: all forwards then all backwards, stash "
+        "grows with num_microbatches). Both are numerically identical; "
+        "PipelinePlan.last_bubble records the per-stage bubble accounting "
+        "either way")
 # async Communicator knobs (reference python/paddle/fluid/__init__.py:65-71)
 _define("communicator_max_merge_var_num", 20,
         "max gradients merged into one send (reference "

@@ -167,6 +167,10 @@ class DistributedStrategy:
         self.nrings = 1
         self.mode = "grad_allreduce"  # or "local_sgd"
         self.local_sgd_k = 1
+        # collective-overlap knobs (parallel/collective.py): None defers to
+        # FLAGS_allreduce_bucket_mb / the tuning DB and FLAGS_zero1
+        self.allreduce_bucket_mb = None
+        self.zero1 = None
 
 
 class CollectiveOptimizer:
@@ -191,7 +195,9 @@ class CollectiveOptimizer:
         if self._strategy.mode == "local_sgd":
             t = LocalSGD(self._strategy.nrings, self._strategy.local_sgd_k)
         else:
-            t = GradAllReduce(self._strategy.nrings)
+            t = GradAllReduce(self._strategy.nrings,
+                              bucket_mb=self._strategy.allreduce_bucket_mb,
+                              zero1=self._strategy.zero1)
         t.transpile(
             startup_program or default_startup_program(),
             loss.block.program,

@@ -35,6 +35,19 @@ def _axis(ctx: ExecContext):
     return env.get(ring, env.get(0))
 
 
+def _axis_size(axis):
+    """jax.lax.axis_size where available (it landed after 0.4.x); else the
+    shard_map-safe spelling — a psum of 1 over the axis."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.lax.psum(1, axis)
+
+
+def _axis_index(axis):
+    return jax.lax.axis_index(axis)
+
+
 def _allreduce(red):
     def compute(ctx: ExecContext):
         from ..core.selected_rows import is_selected_rows
@@ -57,7 +70,7 @@ def _allreduce(red):
                 # fused mean-allreduce: the 1/nranks scale lives INSIDE the op
                 # so it only applies when a real reduction happens (a separate
                 # scale op would corrupt grads in the GSPMD identity regime)
-                out = out / jax.lax.axis_size(axis)
+                out = out / _axis_size(axis)
             return {"Out": out}
         if red == "max":
             return {"Out": jax.lax.pmax(x, axis)}
@@ -78,6 +91,58 @@ register_op("c_allreduce_prod", grad="none")(_allreduce("prod"))
 register_op("allreduce")(_allreduce("sum"))  # legacy dygraph DP op
 
 
+@register_op("c_allreduce_coalesced", grad="none")
+def c_allreduce_coalesced(ctx: ExecContext):
+    """Bucketed mean-allreduce (the fuse_all_reduce_op_pass analogue, done
+    in the program instead of the SSA graph): every gradient in the X list
+    rides ONE flattened psum, so a bucket costs one collective launch and
+    its reduce can overlap the backward compute that produces the NEXT
+    bucket. Sum order per element is identical to the per-gradient
+    c_allreduce_sum (psum over the same axis), so bucketing is bitwise
+    payload-layout-invariant — the exactness contract the parity tests pin.
+    Under GSPMD (no bound axis) it passes every input through untouched,
+    matching c_allreduce_sum's identity regime."""
+    from ..core.selected_rows import is_selected_rows
+
+    xs = ctx.inputs("X")
+    for x in xs:
+        if is_selected_rows(x):
+            raise TypeError(
+                "c_allreduce_coalesced: SelectedRows gradients cannot ride "
+                "a coalesced collective — use the parameter-server path for "
+                "is_sparse=True embeddings, or build the model with "
+                "is_sparse=False for collective mode")
+    axis = _axis(ctx)
+    if axis is None:
+        return {"Out": list(xs)}
+    # one VARIADIC psum: jax reduces the whole tuple in a single XLA
+    # all-reduce (multi-operand), so the bucket pays one collective launch
+    # with zero flatten/concat/split copies — per element the sum is the
+    # same psum c_allreduce_sum emits, hence the bitwise parity contract
+    red = jax.lax.psum(tuple(xs), axis)
+    if ctx.attr("avg", False):
+        n = _axis_size(axis)
+        red = tuple(r / n for r in red)
+    return {"Out": list(red)}
+
+
+@register_op("zero1_shard", grad="none")
+def zero1_shard(ctx: ExecContext):
+    """This rank's 1/nranks leading-dim slice of X (ZeRO-1 optimizer-state
+    sharding, parallel/sharding.py): rank i of the ring's axis owns rows
+    [i*k, (i+1)*k). Under GSPMD (no bound axis) it degrades to identity —
+    the whole ZeRO-1 rewrite then collapses to the plain update, which is
+    the correct single-program semantics there."""
+    x = ctx.input("X")
+    axis = _axis(ctx)
+    if axis is None:
+        return {"Out": x}
+    n = _axis_size(axis)
+    k = x.shape[0] // n
+    idx = _axis_index(axis)
+    return {"Out": jax.lax.dynamic_slice_in_dim(x, idx * k, k, axis=0)}
+
+
 @register_op("c_allgather")
 def c_allgather(ctx: ExecContext):
     x = ctx.input("X")
@@ -89,11 +154,23 @@ def c_allgather(ctx: ExecContext):
 
 @register_op("c_reducescatter")
 def c_reducescatter(ctx: ExecContext):
+    from ..core.selected_rows import is_selected_rows
+
     x = ctx.input("X")
+    if is_selected_rows(x):
+        raise TypeError(
+            "c_reducescatter: SelectedRows gradients cannot ride a "
+            "reduce-scatter — use the parameter-server path for "
+            "is_sparse=True embeddings (ZeRO-1 shards dense grads only)")
     axis = _axis(ctx)
     if axis is None:
         return {"Out": x}
-    return {"Out": jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)}
+    out = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if ctx.attr("avg", False):
+        # fused mean like c_allreduce_sum's `avg`: the scale only applies
+        # when a real reduction runs (identity in the GSPMD regime above)
+        out = out / _axis_size(axis)
+    return {"Out": out}
 
 
 @register_op("c_broadcast")
@@ -117,7 +194,7 @@ def c_collective_permute(ctx: ExecContext):
     axis = _axis(ctx)
     if axis is None:
         return {"Out": x}
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     shift = ctx.attr("shift", 1)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return {"Out": jax.lax.ppermute(x, axis, perm)}
@@ -137,7 +214,7 @@ def local_sgd_sync(ctx: ExecContext):
     axis = _axis(ctx)
     delta = p - snap
     if axis is not None:
-        delta = jax.lax.psum(delta, axis) / jax.lax.axis_size(axis)
+        delta = jax.lax.psum(delta, axis) / _axis_size(axis)
     synced = snap + delta
     do_sync = (step % k) == 0
     new_p = jnp.where(do_sync, synced, p)

@@ -1160,11 +1160,15 @@ class PipelineOptimizer:
 
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
-                 start_cpu_core_id=0, num_microbatches=4):
+                 start_cpu_core_id=0, num_microbatches=4, schedule=None):
         self._inner_opt = optimizer
         self._cut_list = cut_list or []
         self._place_list = place_list
         self._num_microbatches = num_microbatches
+        # "1f1b" (default via FLAGS_pipeline_schedule) or "gpipe"; both are
+        # numerically identical — 1f1b bounds the boundary stash at ~n_stages
+        # microbatches where gpipe's grows with num_microbatches
+        self._schedule = schedule
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -1182,7 +1186,8 @@ class PipelineOptimizer:
         program = loss.block.program
         program._pipeline = build_pipeline_plan(
             program, loss, cuts, self._inner_opt, self._num_microbatches,
-            startup_program, devices=self._place_list)
+            startup_program, devices=self._place_list,
+            schedule=self._schedule)
         return [], []
 
 

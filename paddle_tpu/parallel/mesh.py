@@ -13,7 +13,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "CommContext", "get_comm_context", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
+__all__ = ["make_mesh", "axes_desc", "CommContext", "get_comm_context", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
 
 DATA_AXIS = "dp"
 MODEL_AXIS = "tp"
@@ -44,6 +44,19 @@ def make_mesh(shape: dict | None = None, places=None, devices=None) -> Mesh:
         sizes[sizes.index(-1)] = n // known
     arr = np.array(devs[: int(np.prod(sizes))]).reshape(sizes)
     return Mesh(arr, tuple(names))
+
+
+def axes_desc(mesh_or_nranks) -> str:
+    """Canonical mesh descriptor for tuning keys ('dp8', 'dp2tp2sp2'):
+    the `mesh=` component of `collective|mesh=..|payload=..` decisions.
+    One shared spelling so the transpiler's consult
+    (parallel/collective.resolve_bucket_mb) and the sweeper's record
+    (tools/_mc_ab.py) can never key-drift apart. Accepts a Mesh or a bare
+    rank count (a dp-only ring)."""
+    if isinstance(mesh_or_nranks, (int, np.integer)):
+        return f"{DATA_AXIS}{int(mesh_or_nranks)}"
+    m = mesh_or_nranks
+    return "".join(f"{name}{int(m.shape[name])}" for name in m.axis_names)
 
 
 class CommContext:

@@ -60,7 +60,19 @@ from ..framework import (
     program_guard,
 )
 
-__all__ = ["PipelinePlan", "build_pipeline_plan"]
+__all__ = ["PipelinePlan", "build_pipeline_plan", "bubble_fraction"]
+
+
+def bubble_fraction(n_stages: int, num_microbatches: int) -> float:
+    """Analytic pipeline-bubble fraction (S-1)/(M+S-1): the share of each
+    stage's schedule spent idle during fill+drain. Identical for GPipe and
+    1F1B — 1F1B's win is the BOUNDED STASH (peak <= S+1 live microbatches vs
+    M), not fewer bubbles; the measured counterpart is
+    PipelinePlan.last_bubble after a run_step."""
+    s, m = int(n_stages), int(num_microbatches)
+    if s <= 1:
+        return 0.0
+    return (s - 1) / (m + s - 1)
 
 _GRAD_IN_SUFFIX = "@GRAD@IN"  # feed var carrying the next stage's cotangent
 
@@ -189,10 +201,16 @@ def resolve_devices(place_list, n_stages: int):
 def build_pipeline_plan(program: Program, loss: Variable, cut_vars,
                         inner_opt, num_microbatches: int,
                         startup_program: Program | None = None,
-                        devices=None, schedule: str = "1f1b", mesh=None):
-    """Split `program` (forward-only) at `cut_vars` into a PipelinePlan."""
+                        devices=None, schedule: str | None = None, mesh=None):
+    """Split `program` (forward-only) at `cut_vars` into a PipelinePlan.
+
+    schedule: "1f1b" | "gpipe"; None resolves FLAGS_pipeline_schedule."""
     from ..backward import gradients
 
+    if schedule is None:
+        from .. import flags
+
+        schedule = str(flags.get_flag("pipeline_schedule")).strip().lower()
     if num_microbatches < 1:
         raise ValueError("num_microbatches must be >= 1")
     block = program.global_block
@@ -369,6 +387,11 @@ class PipelinePlan:
         # max #microbatches with live boundary stash during the last step —
         # the 1f1b memory claim is peak <= n_stages + 1 (vs M for gpipe)
         self.last_peak_stash: int = 0
+        # explicit bubble accounting for the last run_step: per-stage idle
+        # slots (cycles/rounds where the stage had pending work but its
+        # dependencies weren't met — the fill/drain bubble made observable)
+        # next to the analytic (S-1)/(M+S-1); bench --multichip records it
+        self.last_bubble: dict = {}
         self._step_counter = 0
         if devices is not None:
             self._check_no_cross_stage_params()
@@ -435,10 +458,17 @@ class PipelinePlan:
                     scope.set_var(n, jax.device_put(v, dev))
 
     def run_step(self, exe, scope, feed: dict, fetch_names: list[str]):
+        from ..core.types import np_feed_dtype
+
         M = self.num_microbatches
         micro_feeds: list[dict[str, Any]] = [dict() for _ in range(M)]
         for name, val in feed.items():
             val = np.asarray(val)
+            # narrow 64-bit host feeds on the HOST (explicit truncation):
+            # an int64 chunk reaching device_put under x64-off jax would
+            # warn-and-truncate per microbatch per stage (the MULTICHIP
+            # dryrun-tail pollution; same discipline as Executor.run feeds)
+            val = val.astype(np_feed_dtype(val.dtype), copy=False)
             if val.shape[0] % M != 0:
                 raise ValueError(
                     f"feed '{name}' batch {val.shape[0]} is not divisible by "
@@ -546,7 +576,7 @@ class PipelinePlan:
                 g = grad_stash[m].get(n)
                 if g is None:
                     g = np.zeros(shape_of[n],
-                                 stage.fwd.global_block.var(n).np_dtype)
+                                 stage.fwd.global_block.var(n).np_feed_dtype)
                 f[n + _GRAD_IN_SUFFIX] = self._to_dev(g, devs[s])
             outs = exe.run(self._stage_prog(s, "bwd"), feed=f,
                            fetch_list=wanted, scope=scope,
@@ -573,25 +603,33 @@ class PipelinePlan:
         grad_acc: dict[str, Any] = {}
         grad_stash: list[dict[str, Any]] = [dict() for _ in range(M)]
 
+        stalls = [0] * S
+        rounds = 0
         if self.schedule == "gpipe":
             # --- forward: GPipe clock cycles — cycle t dispatches stage s on
             # microbatch t-s, so with device placement stage s computes
             # microbatch m while stage s+1 computes m-1 (async XLA dispatch
             # on distinct devices = the SectionWorker overlap)
             for t in range(S + M - 1):
+                rounds += 1
                 for s in range(S):
                     m = t - s
                     if 0 <= m < M:
                         _fwd_one(s, m, stash, fetched)
+                    else:
+                        stalls[s] += 1  # fill/drain bubble slot
             # --- backward: reverse clock cycles (stage S-1 leads, stage s
             # runs microbatch m at cycle (S-1-s)+m); every consumer stage
             # s' > s of a boundary var finishes microbatch m strictly before
             # stage s needs its cotangent.
             for t in range(S + M - 1):
+                rounds += 1
                 for s in range(S - 1, -1, -1):
                     m = t - (S - 1 - s)
                     if 0 <= m < M:
                         _bwd_one(s, m, stash, grad_stash, grad_acc)
+                    else:
+                        stalls[s] += 1
         else:
             # --- 1F1B (PipeDream-flush): stage s runs min(S-1-s, M) warmup
             # forwards, then alternates forward/backward in steady state,
@@ -608,6 +646,7 @@ class PipelinePlan:
             fwd_done = [[False] * M for _ in range(S)]
             bwd_done = [[False] * M for _ in range(S)]
             while any(pc[s] < len(local[s]) for s in range(S)):
+                rounds += 1
                 progressed = False
                 for s in range(S):
                     if pc[s] >= len(local[s]):
@@ -616,6 +655,7 @@ class PipelinePlan:
                     if kind == "f":
                         m = fcnt[s]
                         if s > 0 and not fwd_done[s - 1][m]:
+                            stalls[s] += 1  # warmup/dependency bubble
                             continue
                         _fwd_one(s, m, stash, fetched)
                         fwd_done[s][m] = True
@@ -624,6 +664,7 @@ class PipelinePlan:
                         m = bcnt[s]
                         if not fwd_done[s][m] or (
                                 s < S - 1 and not bwd_done[s + 1][m]):
+                            stalls[s] += 1  # drain/cotangent bubble
                             continue
                         _bwd_one(s, m, stash, grad_stash, grad_acc)
                         bwd_done[s][m] = True
@@ -633,6 +674,19 @@ class PipelinePlan:
                 if not progressed:
                     raise RuntimeError(
                         "1F1B schedule deadlocked — dependency bug")
+        # bubble accounting: stall slots per stage over the schedule's
+        # rounds, next to the analytic (S-1)/(M+S-1) both schedules share
+        total_slots = max(1, rounds * S)
+        self.last_bubble = {
+            "schedule": self.schedule,
+            "n_stages": S,
+            "num_microbatches": M,
+            "analytic_frac": round(bubble_fraction(S, M), 4),
+            "rounds": rounds,
+            "stall_rounds_per_stage": list(stalls),
+            "observed_frac": round(sum(stalls) / total_slots, 4),
+            "peak_stash": self.last_peak_stash,
+        }
 
         # --- update: one optimizer step on mean-of-microbatch grads ---------
         inv = 1.0 / M

@@ -22,7 +22,115 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS
 
-__all__ = ["build_shardings", "var_sharding", "annotate_sharding", "annotation_spec"]
+__all__ = ["build_shardings", "var_sharding", "annotate_sharding",
+           "annotation_spec", "apply_zero1", "ZERO1_OP_TYPES"]
+
+# optimizer ops whose update rule is elementwise in (param, grad, moments) —
+# the precondition for shard-update == full-update restricted to the shard.
+# LARS/LAMB compute parameter-wide trust ratios (a norm over the FULL param),
+# so a shard-local update would diverge; they stay on the allreduce path.
+ZERO1_OP_TYPES = {"sgd", "momentum", "adam", "adagrad", "rmsprop", "adamax",
+                  "adadelta", "decayed_adagrad"}
+
+_SHARD_SUFFIX = "@ZERO1_SHARD"
+_GRAD_SUFFIX = "@ZERO1_GRAD"
+
+
+def apply_zero1(program, nranks: int) -> list[str]:
+    """ZeRO-1 optimizer-state sharding, as a program rewrite (the shard_map
+    complement of BuildStrategy.sharded_optimizer_states, which does the
+    same thing through GSPMD annotations).
+
+    For every eligible optimizer op (elementwise update rule, param leading
+    dim divisible by nranks) the per-grad mean-allreduce becomes:
+
+        c_reducescatter(grad)  -> grad shard        [d0/nranks, ...]
+        zero1_shard(param/moments) -> state shards  (this rank's rows)
+        <optimizer op over the shards>
+        c_allgather(shards) -> full param + moments
+
+    The reduce-scatter is inserted where the gradient is FINAL
+    (backward.grad_ready_index — below AMP/clip/guardrails) so it overlaps
+    the remaining backward like the bucketed allreduce; the allgathers sit
+    directly after the update, at the program tail, where XLA's async
+    collectives — and the run_async inflight window — overlap them with the
+    next step's first buckets. Under GSPMD every inserted collective lowers
+    to identity and the rewrite collapses to the plain full update.
+
+    Returns the param names rewritten; everything else (indivisible leading
+    dim, scalar params, non-elementwise optimizers) is left for the caller's
+    bucketed-allreduce path."""
+    from ..backward import grad_ready_index
+
+    block = program.global_block
+    handled: list[str] = []
+    opt_ops = [op for op in block.ops if op.type in ZERO1_OP_TYPES]
+    if not opt_ops:
+        return handled
+    first_opt = min(block.ops.index(op) for op in opt_ops)
+
+    for op in reversed(opt_ops):
+        pname = op.input("Param")[0]
+        gname = op.input("Grad")[0]
+        pvar = block.var(pname)
+        d0 = pvar.shape[0] if pvar.shape else 0
+        if len(pvar.shape) < 1 or d0 < nranks or d0 % nranks != 0:
+            continue
+        shard0 = d0 // nranks
+
+        # classify state inputs: every non-Grad/LR input sharing the param's
+        # leading dim shards with it (Param, Velocity, Moment1/2, ...);
+        # scalars (Beta*Pow, LearningRate) stay replicated
+        shard_of: dict[str, str] = {}
+        for slot, names in op.inputs.items():
+            if slot in ("Grad", "LearningRate"):
+                continue
+            for n in names:
+                if not n or not block.has_var(n):
+                    continue
+                v = block.var(n)
+                if v.shape and v.shape[0] == d0:
+                    shard_of[n] = n + _SHARD_SUFFIX
+
+        gshard = gname + _GRAD_SUFFIX
+        gvar = block.var(gname)
+        block.create_var(name=gshard, shape=[shard0] + list(gvar.shape[1:]),
+                         dtype=gvar.dtype)
+        for n, sn in shard_of.items():
+            v = block.var(n)
+            block.create_var(name=sn, shape=[shard0] + list(v.shape[1:]),
+                             dtype=v.dtype)
+
+        # rewrite the op in place: shard inputs, and every output aliasing a
+        # sharded input writes the shard (ParamOut -> param@ZERO1_SHARD)
+        op.inputs = {
+            slot: [gshard if n == gname else shard_of.get(n, n)
+                   for n in names]
+            for slot, names in op.inputs.items()}
+        op.outputs = {slot: [shard_of.get(n, n) for n in names]
+                      for slot, names in op.outputs.items()}
+
+        # allgathers AFTER the update (full names restored for the scope
+        # write-back and the next forward)
+        i = block.ops.index(op)
+        for n, sn in sorted(shard_of.items(), reverse=True):
+            block._insert_op(i + 1, "c_allgather", {"X": [sn]}, {"Out": [n]},
+                            {"ring_id": 0})
+        # state shards directly BEFORE the update
+        for n, sn in sorted(shard_of.items(), reverse=True):
+            block._insert_op(i, "zero1_shard", {"X": [n]}, {"Out": [sn]},
+                            {"ring_id": 0})
+        # mean reduce-scatter of the gradient at its readiness point
+        ready = grad_ready_index(block, gname, first_opt)
+        block._insert_op(
+            (ready + 1) if ready >= 0 else block.ops.index(op),
+            "c_reducescatter", {"X": [gname]}, {"Out": [gshard]},
+            {"ring_id": 0, "avg": True})
+        first_opt += 1  # the rs insert shifted everything at/above it
+        handled.append(pname)
+
+    handled.reverse()
+    return handled
 
 
 def annotate_sharding(var, spec: tuple):

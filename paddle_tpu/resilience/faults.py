@@ -31,6 +31,13 @@ host, reproducibly. This module plants named *sites* in the hot paths —
                       scaled 1e4x, driving a finite loss spike that the
                       sentinel's EMA gate (FLAGS_guard_spike_factor) must
                       catch
+    collective_stall  Executor's async completion-token drain, for steps
+                      dispatched under the shard_map/with_collective regime
+                      only — the drain wedges as if one rank of the mesh
+                      never posted its allreduce (a lost collective
+                      partner), so the PR 3 watchdog must surface the hung
+                      allreduce with step ids and queue depths instead of
+                      blocking forever
     serving_abort     ServingEngine.step, once per scheduler iteration —
                       the oldest running generate-request is aborted
                       mid-decode (the client vanished), so its KV pages
@@ -67,7 +74,7 @@ __all__ = ["FAULT_SITES", "InjectedFault", "FaultPlan", "fault_point",
 FAULT_SITES = frozenset({
     "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
     "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
-    "numeric_nan", "numeric_spike", "serving_abort",
+    "collective_stall", "numeric_nan", "numeric_spike", "serving_abort",
 })
 
 

@@ -33,7 +33,7 @@ import warnings
 DB_SCHEMA = 1
 
 __all__ = ["DB_SCHEMA", "TuningDB", "canonical_key", "conv_key",
-           "attention_key", "bucket_key", "amp_key"]
+           "attention_key", "bucket_key", "amp_key", "collective_key"]
 
 
 def canonical_key(op: str, shape_key: str, dtype: str, device_kind: str) -> str:
@@ -67,6 +67,20 @@ def bucket_key(var_name: str, dim: int, raw_extent: int) -> str:
 def amp_key(op_type: str) -> str:
     # AMP list membership is a per-op-TYPE decision (shapeless)
     return f"op={op_type}"
+
+
+def collective_key(mesh_desc: str, payload_bytes: int) -> str:
+    """Gradient-bucket sizing decisions (parallel/collective.py) key on the
+    mesh layout and the TOTAL gradient payload, pow2-quantized in MB so one
+    swept verdict covers the jitter between model revisions: bucket sizing
+    trades per-collective launch/latency overhead against overlap
+    granularity, and both scale with (ranks, payload), not with exact
+    parameter shapes."""
+    mb = max(1, int(payload_bytes) >> 20)
+    q = 1
+    while q < mb:
+        q <<= 1
+    return f"mesh={mesh_desc} payload={q}mb"
 
 
 class TuningDB:
