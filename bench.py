@@ -164,23 +164,94 @@ def bench_bert_long(on_tpu: bool):
     return out
 
 
-def bench_resnet(on_tpu: bool, peak: float):
-    """ResNet-50 row with an in-artifact lever A/B (PERF.md r6): the step is
-    timed twice — conv levers OFF (direct conv + two-pass BN, the r5
-    configuration) and ON (FLAGS_conv_implicit_gemm auto + fused one-pass
-    BN statistics) — and the headline takes the faster arm, with both
-    recorded so every round re-measures the levers end-to-end (the same
-    keep-it-honest protocol as the bert_s512 pallas rows)."""
+def bench_bert_short(on_tpu: bool):
+    """BERT at the HEADLINE short sequence — the regime where the bundled
+    flash kernel measured 42-52% SLOWER than XLA (PERF.md r4/r5) and the
+    ISSUE 9 seq<=128 kernel (pallas_kernels/short_attention.py) now fields
+    a custom arm. Interleaved end-to-end A/B on the bench step protocol:
+    the same config timed with the attention dispatch forced to XLA and
+    forced to pallas_short128 (FLAGS_attention_force_backend; a force the
+    platform cannot honor degrades to XLA at dispatch, recorded via
+    `engaged`). tools/gate.py fails an artifact whose ENGAGED kernel arm
+    loses beyond the interference band."""
     from paddle_tpu import flags as pt_flags
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas_kernels import short_attention as _s128
+    from paddle_tpu.ops.pallas_kernels import workbench as _wb
+    from tools import _timing
+
+    if on_tpu:
+        cfg = transformer.TransformerConfig(**BERT_BASE)
+        batch, seq, iters = 128, 128, 50
+    else:
+        cfg = transformer.bert_tiny(use_tp=False)
+        batch, seq, iters = 8, 32, 3
+
+    out = {}
+    saved = pt_flags.get_flag("attention_force_backend")
+    try:
+        # interleaved passes (ABAB): sequential per-arm measurement aliases
+        # box drift into the margin (the PERF.md r9 lesson)
+        tok = {}
+        for rep in range(2):
+            for arm in ("xla", "pallas_short128"):
+                pt_flags.set_flags({"attention_force_backend": arm})
+                dt, _ = _bert_step_time(cfg, batch, seq, iters)
+                tok.setdefault(arm, []).append(batch * seq / dt)
+        out["xla_tok_s"] = round(max(tok["xla"]), 1)
+        out["pallas_tok_s"] = round(max(tok["pallas_short128"]), 1)
+        out["windows_tok_s"] = {a: [round(v, 1) for v in vs]
+                                for a, vs in tok.items()}
+    finally:
+        pt_flags.set_flags({"attention_force_backend": saved})
+    dh = cfg.hidden_size // cfg.num_heads
+    q_shape = (batch, cfg.num_heads, seq, dh)
+    out["engaged"] = bool(
+        _wb.runnable(_s128)
+        and _s128.short128_supported(q_shape, q_shape, None))
+    band = max(_timing.DEFAULT_BAND,
+               _timing.interference_band(tok["xla"]),
+               _timing.interference_band(tok["pallas_short128"]))
+    out["band"] = round(band, 4)
+    out["verdict"] = _timing.ab_verdict(
+        1.0 / max(tok["xla"]), 1.0 / max(tok["pallas_short128"]), band)
+    out["config"] = (f"base b{batch} s{seq} AMP Adam" if on_tpu
+                     else f"tiny b{batch} s{seq}")
+    return out
+
+
+def bench_resnet(on_tpu: bool, peak: float):
+    """ResNet-50 row with an in-artifact lever A/B (PERF.md r6/r10): the
+    step is timed three ways — conv levers OFF (direct conv + two-pass BN,
+    the r5 configuration), ON (FLAGS_conv_implicit_gemm auto + fused
+    one-pass BN statistics), and ON + the fused Pallas epilogue forced
+    (FLAGS_pallas_epilogue=on: the ISSUE 9 normalize+affine+act+residual
+    kernel carries every BN apply tail it can run) — and the headline takes
+    the fastest arm, with all recorded so every round re-measures the
+    levers end-to-end (the keep-it-honest protocol; chained microbenches
+    are poisoned here, PERF.md r5). The epilogue arm also records whether
+    its kernel could actually engage (`engaged`: off-TPU without the
+    interpreter the dispatch degrades to XLA and the arm measures pure
+    rewrite overhead) and its keep/retire verdict vs the levered arm on
+    the tools/_timing.py band — tools/gate.py fails an artifact whose
+    ENGAGED kernel arm loses beyond the band."""
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu.ops.pallas_kernels import epilogue as _ep
+    from paddle_tpu.ops.pallas_kernels import workbench as _wb
+    from tools import _timing
 
     arms = {}
     saved = {k: pt_flags.get_flag(k)
-             for k in ("conv_implicit_gemm", "bn_fuse_stats")}
+             for k in ("conv_implicit_gemm", "bn_fuse_stats",
+                       "pallas_epilogue")}
     try:
-        for name, (igemm, fuse) in (("baseline", ("off", False)),
-                                    ("levered", ("auto", True))):
+        for name, (igemm, fuse, epi) in (
+                ("baseline", ("off", False, "off")),
+                ("levered", ("auto", True, "off")),
+                ("epilogue", ("auto", True, "on"))):
             pt_flags.set_flags({"conv_implicit_gemm": igemm,
-                                "bn_fuse_stats": fuse})
+                                "bn_fuse_stats": fuse,
+                                "pallas_epilogue": epi})
             arms[name] = _resnet_arm(on_tpu, peak)
     finally:
         pt_flags.set_flags(saved)
@@ -188,6 +259,19 @@ def bench_resnet(on_tpu: bool, peak: float):
     img_s, mfu, windows = arms[best]
     ab = {f"{k}_img_s": round(v[0], 1) for k, v in arms.items()}
     ab["winner"] = best
+    # the epilogue kernel's end-to-end verdict vs its own baseline (the
+    # levered arm: identical levers, kernel off) — per-step seconds feed
+    # the shared band protocol
+    eng = _wb.runnable(_ep)
+    # interference_band is scale-invariant, so the recorded img/s windows
+    # feed it directly
+    band = max(_timing.DEFAULT_BAND,
+               _timing.interference_band(arms["levered"][2]),
+               _timing.interference_band(arms["epilogue"][2]))
+    ab["epilogue_engaged"] = eng
+    ab["epilogue_band"] = round(band, 4)
+    ab["epilogue_verdict"] = _timing.ab_verdict(
+        1.0 / arms["levered"][0], 1.0 / arms["epilogue"][0], band)
     return img_s, mfu, windows, ab
 
 
@@ -574,6 +658,8 @@ def main():
     ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = _tuned(
         tuner_stats, "deepfm", bench_deepfm, on_tpu)
     long_ctx = _tuned(tuner_stats, "bert_s512", bench_bert_long, on_tpu)
+    short_ab = _tuned(tuner_stats, "bert_s128_shortattn", bench_bert_short,
+                      on_tpu)
     serving = _tuned(tuner_stats, "serving", bench_serving, on_tpu)
 
     # Per-workload targets. MFU workloads: the 0.45 north star
@@ -632,6 +718,10 @@ def main():
         # seq-512 tokens/s with the kernel off vs on (on wins ~9%)
         "bert_s512_tokens_per_sec_xla_attn": round(long_ctx["xla"], 2),
         "bert_s512_tokens_per_sec_pallas_attn": round(long_ctx["pallas"], 2),
+        # ISSUE 9: the seq<=128 short-attention kernel's end-to-end A/B
+        # (interleaved ABAB, FLAGS_attention_force_backend arms); gate.py
+        # fails if the kernel ENGAGED and lost beyond the band
+        "bert_s128_shortattn_ab": short_ab,
         # the serving runtime's open-loop load row (serving/): served
         # tokens/s, p50/p99 request + first-token latency, KV-pool
         # occupancy. tools/gate.py fails on leaked KV pages and on a

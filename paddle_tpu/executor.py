@@ -1079,11 +1079,6 @@ class Executor:
 
             from .parallel.mesh import get_comm_context
 
-            try:
-                from jax import shard_map as _shard_map
-            except ImportError:  # pragma: no cover - older jax spelling
-                from jax.experimental.shard_map import shard_map as _shard_map
-
             ctx = get_comm_context()
             data_axis_name = mesh.axis_names[0]
             # explicitly-registered rings must name a real mesh axis (silent
@@ -1137,14 +1132,10 @@ class Executor:
                 tuple(P() for _ in extra_w),
                 P(),  # async completion token
             )
-            try:
-                sfn = _shard_map(
-                    fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
-                )
-            except TypeError:  # older jax spells the kwarg check_rep
-                sfn = _shard_map(
-                    fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
-                )
+            from .ops.collective_ops import compat_shard_map
+
+            sfn = compat_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs)
             jfn = jax.jit(sfn, donate_argnums=(2,))
             comp = _Compiled(jfn, feed_names, ro_names, rw_names, fetch_names)
             comp.extra_w = extra_w

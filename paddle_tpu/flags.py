@@ -83,6 +83,25 @@ _define("tuning_db", "",
         "JSON, atomic temp+rename writes; tuning/db.py). Empty = no DB: "
         "consult mode degrades to the analytic priors. A corrupt/missing "
         "file warns once and falls back to analytic — never an error")
+_define("pallas_epilogue", "auto",
+        "fused normalize+affine+activation(+residual) epilogue kernels "
+        "(ops/pallas_kernels/epilogue.py). 'auto' (default): when "
+        "FLAGS_tuning_mode is consult/sweep, minimize() rewrites eligible "
+        "batch_norm/conv2d_bn/layer_norm -> activation (-> residual-add) "
+        "chains into one op whose epilogue DISPATCHES through the tuning "
+        "DB — the analytic prior is XLA (the plain jnp composition, "
+        "bit-identical to the unfused chain), so the Pallas kernel engages "
+        "only where a swept verdict keeps it; with tuning off, 'auto' "
+        "changes nothing. 'on' forces the kernel wherever it can run (the "
+        "A/B arms); 'off' disables the rewrite entirely")
+_define("attention_force_backend", "",
+        "A/B-harness override for the fused-attention dispatch: force every "
+        "attention_backend decision to this arm ('xla', 'pallas_short', "
+        "'pallas_short128', 'flash_bundled') regardless of the tuning DB "
+        "and the analytic prior. A forced backend the platform/shape cannot "
+        "run still degrades to the XLA reference at dispatch (so an arm is "
+        "honest about where its kernel engaged). Empty (default) = normal "
+        "three-tier dispatch")
 _define("pallas_xent", False,
         "route large-vocab hard-label softmax_with_cross_entropy through "
         "the Pallas TPU kernel (ops/pallas_kernels/xent.py). Default OFF: "

@@ -66,9 +66,18 @@ def _block_multiple_ok(s: int) -> bool:
 
 def _pallas_short_ok(q_shape, k_shape, bias) -> bool:
     from .pallas_kernels import attention as psa
+    from .pallas_kernels import workbench
 
-    return ((_on_tpu() or psa.INTERPRET)
+    return (workbench.runnable(psa)
             and psa.short_seq_supported(q_shape, k_shape, bias))
+
+
+def _pallas_short128_ok(q_shape, k_shape, bias) -> bool:
+    from .pallas_kernels import short_attention as s128
+    from .pallas_kernels import workbench
+
+    return (workbench.runnable(s128)
+            and s128.short128_supported(q_shape, k_shape, bias))
 
 
 def _flash_bundled_ok(q_shape, k_shape, dtype) -> bool:
@@ -91,9 +100,21 @@ def attention_backend(q_shape, k_shape, dtype, bias=None, causal=False,
     measured BENCH_r05 split (XLA wins at seq<=128, the Pallas kernel wins
     ~9% at s512) becomes a cache entry instead of a per-model flag. A
     swept backend the current build cannot execute is degraded at dispatch
-    time (flash_attention), never obeyed blindly."""
+    time (flash_attention), never obeyed blindly.
+
+    The seq<=128 regime additionally carries the `pallas_short128` arm
+    (pallas_kernels/short_attention.py — ISSUE 9): the analytic prior keeps
+    XLA there (that is what r4/r5 measured), so the kernel engages only via
+    a swept keep or FLAGS_attention_force_backend (the A/B harness lever,
+    which precedes every tier and still degrades when un-runnable)."""
+    from .. import flags as pt_flags
+
     B, nh, sq, dh = q_shape
     sk = k_shape[2]
+
+    forced = str(pt_flags.get_flag("attention_force_backend")).strip()
+    if forced:
+        return forced, "forced"
 
     def analytic():
         if use_pallas and _pallas_short_ok(q_shape, k_shape, bias):
@@ -118,6 +139,7 @@ def attention_backend(q_shape, k_shape, dtype, bias=None, causal=False,
     decision, tier = tuning.decide(
         "attention", key, prior=analytic, default={"backend": "xla"},
         validate=lambda dd: dd.get("backend") in ("xla", "pallas_short",
+                                                  "pallas_short128",
                                                   "flash_bundled"))
     return decision.get("backend", "xla"), tier
 
@@ -142,6 +164,12 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=1.0,
         from .pallas_kernels import attention as psa
 
         return psa.short_seq_attention(q, k, v, causal=causal,
+                                       sm_scale=float(sm_scale))
+    if backend == "pallas_short128" and _pallas_short128_ok(
+            q.shape, k.shape, bias):
+        from .pallas_kernels import short_attention as s128
+
+        return s128.short128_attention(q, k, v, causal=causal,
                                        sm_scale=float(sm_scale))
     if backend == "flash_bundled" and _flash_bundled_ok(q.shape, k.shape,
                                                         q.dtype):

@@ -27,6 +27,24 @@ from .registry import ExecContext, register_op
 AXIS_ENV_KEY = "__axis_env__"  # env key: dict ring_id/axis info set by executor
 
 
+def compat_shard_map(fn, mesh, in_specs, out_specs, check=False):
+    """Version-tolerant shard_map: the entry point moved from
+    jax.experimental.shard_map to jax.shard_map, and the replication-check
+    kwarg was renamed check_rep -> check_vma across jax releases. One shim
+    (the workbench discipline) so the executor, the ring-attention tests,
+    and any future caller stop carrying private try/except ladders."""
+    try:
+        from jax import shard_map as shard_map_fn
+    except ImportError:  # pragma: no cover - older jax layout
+        from jax.experimental.shard_map import shard_map as shard_map_fn
+    try:
+        return shard_map_fn(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=check)
+    except TypeError:  # 0.4.x spells the kwarg check_rep
+        return shard_map_fn(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_rep=check)
+
+
 def _axis(ctx: ExecContext):
     env = ctx.env.get(AXIS_ENV_KEY)
     if env is None:

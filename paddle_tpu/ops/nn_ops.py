@@ -327,7 +327,11 @@ def _xent_pallas_eligible(logits, soft, ignore) -> bool:
     """Large-vocab hard-label xent on TPU routes to the fused Pallas kernel
     (pallas_kernels/xent.py): the fwd never materializes the softmax and
     the bwd recomputes stats in-VMEM — one logits read fwd, one read + one
-    dlogits write bwd."""
+    dlogits write bwd. FLAGS_pallas_xent stays the master switch (measured
+    and retired r5); with the flag ON and the tuner consulting, a swept
+    per-shape verdict can still retire the kernel for a specific
+    (rows, vocab) tile — the workbench contract that every kernel's
+    dispatch resolves through a tuning decision key."""
     if soft or ignore >= 0 or not flags.get_flag("pallas_xent"):
         return False  # flag off (the default): never pay the pallas import
     from .pallas_kernels import xent as px
@@ -335,8 +339,21 @@ def _xent_pallas_eligible(logits, soft, ignore) -> bool:
     if not (px.INTERPRET or jax.default_backend() in ("tpu", "axon")):
         return False
     n = int(np.prod(logits.shape[:-1]))
-    return px.xent_supported((n, logits.shape[-1]), logits.shape[-1],
-                             dtype=logits.dtype)
+    if not px.xent_supported((n, logits.shape[-1]), logits.shape[-1],
+                             dtype=logits.dtype):
+        return False
+    from .. import tuning
+
+    if tuning.mode() == "off":
+        return True  # flag on + no tuner: the pre-workbench behavior
+    key = tuning.canonical_key(
+        "xent", tuning.xent_key(n, logits.shape[-1]),
+        str(jnp.dtype(logits.dtype)), tuning.device_kind())
+    decision, _tier = tuning.decide(
+        "xent", key, prior=lambda: {"backend": "pallas"},
+        default={"backend": "pallas"},
+        validate=lambda dd: dd.get("backend") in ("xla", "pallas"))
+    return decision.get("backend", "pallas") == "pallas"
 
 
 @register_op("softmax_with_cross_entropy")
@@ -467,6 +484,82 @@ def smooth_l1_loss(ctx: ExecContext):
     return {"Out": jnp.sum(loss, axis=-1, keepdims=True), "Diff": d}
 
 
+# ---------------------------------------------------------------------------
+# Fused epilogue dispatch (ISSUE 9): normalize+affine+activation(+residual)
+# ---------------------------------------------------------------------------
+
+_EPILOGUE_ACTS = {"identity": lambda z: z,
+                  "relu": lambda z: jnp.maximum(z, 0.0)}
+
+
+def _epilogue_backend(kind, rows, channels, channel_pos, act, has_res,
+                      dtype) -> str:
+    """Which implementation carries one fused-epilogue apply: the Pallas
+    kernel or the XLA composition. Same three-tier contract as the conv/
+    attention levers (PR 6): FLAGS_pallas_epilogue 'on'/'off' are hard
+    forces for the A/B arms; 'auto' consults the tuning DB with the XLA
+    composition as the analytic prior — the kernel ships off until a swept
+    verdict keeps it for the exact shape (the r5 rule). Callers still gate
+    on `_epilogue_ok`, so a swept/forced kernel the platform cannot run
+    degrades to XLA at dispatch."""
+    mode = str(flags.get_flag("pallas_epilogue")).strip().lower()
+    if mode == "off":
+        return "xla"
+    if mode == "on":
+        return "pallas"
+    from .. import tuning
+
+    if tuning.mode() == "off":
+        return "xla"
+    key = tuning.canonical_key(
+        "epilogue",
+        tuning.epilogue_key(kind, rows, channels, channel_pos, act, has_res),
+        str(jnp.dtype(dtype)), tuning.device_kind())
+    decision, _tier = tuning.decide(
+        "epilogue", key, prior=lambda: {"backend": "xla"},
+        default={"backend": "xla"},
+        validate=lambda dd: dd.get("backend") in ("xla", "pallas"))
+    return decision.get("backend", "xla")
+
+
+def _epilogue_ok(shape, dtype, channel_last, act) -> bool:
+    from .pallas_kernels import epilogue as ep
+    from .pallas_kernels import workbench
+
+    return (workbench.runnable(ep)
+            and ep.epilogue_supported(shape, dtype, channel_last, act))
+
+
+def _bn_epilogue(x_for_apply, scale, bias, use_mean, inv, act, residual,
+                 channel_last, bshape):
+    """One fused-epilogue finish for batch_norm/conv2d_bn: dispatch per
+    `_epilogue_backend`, Pallas kernel where a verdict keeps it and the
+    shape/platform can run it, the fp32 jnp composition (bit-identical to
+    the pre-fusion op chain) everywhere else."""
+    act = act or "identity"
+    C = x_for_apply.shape[-1 if channel_last else 1]
+    rows = int(np.prod(x_for_apply.shape)) // max(1, C)
+    backend = _epilogue_backend(
+        "bn", rows, C, "last" if channel_last else "row", act,
+        residual is not None, x_for_apply.dtype)
+    if (backend == "pallas"
+            and act in _EPILOGUE_ACTS
+            and _epilogue_ok(x_for_apply.shape, x_for_apply.dtype,
+                             channel_last, act)):
+        from .pallas_kernels import epilogue as ep
+
+        return ep.bn_apply_act(x_for_apply, scale, bias, use_mean, inv,
+                               act=act, residual=residual,
+                               channel_last=channel_last)
+    y = (x_for_apply.astype(jnp.float32) - use_mean.reshape(bshape)) \
+        * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    if residual is not None:
+        y = y + residual.astype(jnp.float32)
+    y = _EPILOGUE_ACTS.get(act, _EPILOGUE_ACTS["identity"])(y)
+    return y.astype(x_for_apply.dtype)
+
+
 @register_op("batch_norm", stateful_outputs=("MeanOut", "VarianceOut"))
 def batch_norm(ctx: ExecContext):
     x = ctx.input("X")
@@ -494,10 +587,19 @@ def batch_norm(ctx: ExecContext):
         saved_mean = use_mean.astype(mean.dtype)
         saved_var = (1.0 / jnp.sqrt(use_var + eps)).astype(var.dtype)
     inv = 1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps)
-    y = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    # fused epilogue (ISSUE 9): the minimize()-time pass may have folded a
+    # trailing activation (attr `act`) and/or a residual add (input
+    # `Residual`) into this op; _bn_epilogue dispatches the whole apply
+    # chain per the tuning DB (Pallas kernel only where a swept verdict
+    # keeps it — XLA composition, bit-identical to the unfused chain,
+    # everywhere else)
+    res = ctx.input("Residual") if ctx.has_input("Residual") else None
+    y = _bn_epilogue(x, scale, bias,
+                     use_mean.astype(jnp.float32), inv,
+                     ctx.attr("act", ""), res,
+                     channel_last=layout != "NCHW", bshape=bshape)
     return {
-        "Y": y.astype(x.dtype),
+        "Y": y,
         "MeanOut": mean_out,
         "VarianceOut": var_out,
         "SavedMean": saved_mean,
@@ -540,8 +642,15 @@ def conv2d_bn(ctx: ExecContext):
     mean_out = mean * momentum + use_mean.astype(mean.dtype) * (1 - momentum)
     var_out = var * momentum + use_var.astype(var.dtype) * (1 - momentum)
     inv = 1.0 / jnp.sqrt(use_var + eps)
-    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    # fused epilogue (ISSUE 9): same contract as batch_norm — the apply
+    # chain (normalize+affine[+residual][+act]) dispatches through the
+    # tuning DB. The Pallas arm normalizes the fp32 accumulator view so the
+    # one-read-one-write kernel sees the exact pre-rounding values the
+    # statistics came from.
+    res = ctx.input("Residual") if ctx.has_input("Residual") else None
+    y = _bn_epilogue(xf, scale, bias, use_mean, inv,
+                     ctx.attr("act", ""), res,
+                     channel_last=fmt != "NCHW", bshape=bshape)
     return {
         "Y": y.astype(out.dtype),
         "MeanOut": mean_out,
@@ -557,17 +666,39 @@ def layer_norm(ctx: ExecContext):
     eps = ctx.attr("epsilon", 1e-5)
     begin = ctx.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
+    act = ctx.attr("act", "") or "identity"
+    scale = ctx.input("Scale") if ctx.has_input("Scale") else None
+    bias = ctx.input("Bias") if ctx.has_input("Bias") else None
     xf = x.astype(jnp.float32)
+    # Mean/Variance outputs stay PLAIN jnp expressions on every backend:
+    # XLA dead-code-eliminates them when nothing consumes them (the usual
+    # case), and gradient contributions through them flow via this jnp
+    # path even when Y comes from the Pallas kernel (whose own backward
+    # recomputes row statistics on-chip and never sees these cotangents)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
-    y = (xf - mean) / jnp.sqrt(var + eps)
-    norm_shape = x.shape[begin:]
-    if ctx.has_input("Scale"):
-        y = y * ctx.input("Scale").reshape(norm_shape).astype(jnp.float32)
-    if ctx.has_input("Bias"):
-        y = y + ctx.input("Bias").reshape(norm_shape).astype(jnp.float32)
+    R = int(np.prod(x.shape[:begin])) if begin else 1
+    K = int(np.prod(x.shape[begin:]))
+    backend = _epilogue_backend("ln", R, K, "last", act, False, x.dtype)
+    if backend == "pallas" and _epilogue_ok((R, K), x.dtype, True, act):
+        from .pallas_kernels import epilogue as ep
+
+        y = ep.layer_norm_act(
+            x.reshape(R, K),
+            scale.reshape(-1) if scale is not None else None,
+            bias.reshape(-1) if bias is not None else None,
+            eps=eps, act=act).reshape(x.shape)
+    else:
+        norm_shape = x.shape[begin:]
+        y = (xf - mean) / jnp.sqrt(var + eps)
+        if scale is not None:
+            y = y * scale.reshape(norm_shape).astype(jnp.float32)
+        if bias is not None:
+            y = y + bias.reshape(norm_shape).astype(jnp.float32)
+        y = _EPILOGUE_ACTS.get(act, _EPILOGUE_ACTS["identity"])(y)
+        y = y.astype(x.dtype)
     return {
-        "Y": y.astype(x.dtype),
+        "Y": y,
         "Mean": mean.reshape(x.shape[:begin]).astype(jnp.float32),
         "Variance": var.reshape(x.shape[:begin]).astype(jnp.float32),
     }

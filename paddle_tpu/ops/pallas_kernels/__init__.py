@@ -2,9 +2,29 @@
 
 XLA fuses most of the framework's ops well; these kernels exist for the
 cases where measurement (PERF.md) showed XLA leaving throughput on the
-table. Each kernel module exposes a plain jax-callable function with a
-custom VJP so the op registry's derived-gradient machinery works through it.
+table. The package is organized as a small kernel WORKBENCH (workbench.py):
+shared version-tolerant CompilerParams, block-shape/VMEM helpers, and a
+registry in which every kernel records its XLA reference, shape gate,
+tuning-DB decision op, and equivalence test — `tools/gate.py
+check_kernel_registry` fails the build on any kernel missing one, so no
+unmeasured kernel can land silently. Each kernel module exposes a plain
+jax-callable function with a custom VJP so the op registry's
+derived-gradient machinery works through it, and dispatches through the
+tuning layer (keep-or-retire per shape, degradation to the reference when
+the platform cannot run the kernel).
 """
+from . import workbench
 from .attention import short_seq_attention, short_seq_supported
+from .epilogue import (bn_apply_act, bn_apply_act_reference,
+                       epilogue_supported, layer_norm_act,
+                       layer_norm_act_reference)
+from .short_attention import short128_attention, short128_supported
+from .workbench import all_kernels, register_kernel
 
-__all__ = ["short_seq_attention", "short_seq_supported"]
+__all__ = [
+    "workbench", "all_kernels", "register_kernel",
+    "short_seq_attention", "short_seq_supported",
+    "short128_attention", "short128_supported",
+    "bn_apply_act", "bn_apply_act_reference", "epilogue_supported",
+    "layer_norm_act", "layer_norm_act_reference",
+]

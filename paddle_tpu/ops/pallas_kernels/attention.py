@@ -29,7 +29,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from . import workbench
 
 _NEG_INF = -1e30
 # per-step VMEM budget for the head-block (bytes); leaves room for double
@@ -125,8 +126,10 @@ def _hb_spec(gh, s, dh):
 
 
 def _params():
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel"))
+    # version-tolerant CompilerParams via the workbench shim: the bare
+    # pltpu.CompilerParams spelling broke on jax 0.4.x (TPUCompilerParams
+    # there) and took test_pallas_attention with it
+    return workbench.compiler_params(("parallel", "parallel"))
 
 
 def _fwd(q, k, v, sm_scale, causal, interpret):
@@ -184,6 +187,27 @@ def _make(sm_scale: float, causal: bool, interpret: bool):
     return attn
 
 
+def _reference(q, k, v, causal=False, sm_scale=1.0):
+    """XLA reference for the registry lint/equivalence contract — the
+    einsum composition from ops/attention_ops (duplicated minimally here to
+    avoid a circular import)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), sk - sq)
+        scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+
+
+@workbench.register_kernel(
+    "attention_short_seq",
+    reference=_reference,
+    supported=short_seq_supported,
+    decision_op="attention",
+    equivalence_test="test_fwd_matches_reference",
+    note="fused self-attention for S in {128, 256, 384, 512} (S % 128 == 0;"
+         " head-blocked VMEM slabs, fused no-residual backward)")
 def short_seq_attention(q, k, v, causal=False, sm_scale=1.0):
     """Fused attention for VMEM-resident sequence lengths.
 

@@ -53,10 +53,10 @@ def paged_supported(q_shape, pool_shape) -> bool:
 
 
 def _compiler_params():
-    # jax moved CompilerParams -> TPUCompilerParams and back across versions
-    cp = (getattr(pltpu, "CompilerParams", None)
-          or getattr(pltpu, "TPUCompilerParams"))
-    return cp(dimension_semantics=("parallel", "arbitrary"))
+    # version-tolerant spelling via the shared workbench shim
+    from . import workbench
+
+    return workbench.compiler_params(("parallel", "arbitrary"))
 
 
 def _decode_kernel(pt_ref, kl_ref, q_ref, k_ref, v_ref, o_ref,
@@ -141,6 +141,26 @@ def _call(q, k_pool, v_pool, page_table, kv_lens, sm_scale, interpret):
     )(page_table, kv_lens, q, k_pool, v_pool)
 
 
+def _workbench_register():
+    from . import workbench
+
+    def _reference(q, k_pool, v_pool, page_table, kv_lens, sm_scale=1.0):
+        from ..attention_ops import _paged_attention_reference
+
+        return _paged_attention_reference(q, k_pool, v_pool, page_table,
+                                          kv_lens, sm_scale)
+
+    return workbench.register_kernel(
+        "attention_paged_decode",
+        reference=_reference,
+        supported=paged_supported,
+        decision_op="attention",
+        equivalence_test="test_paged_attention_pallas_matches_reference",
+        note="ragged paged decode attention (sq=1) over the KV page pool; "
+             "scalar-prefetch page-table DMA, forward-only")
+
+
+@_workbench_register()
 def paged_decode_attention(q, k_pool, v_pool, page_table, kv_lens,
                            sm_scale=1.0):
     """One decode step of ragged paged attention.

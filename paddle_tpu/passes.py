@@ -11,6 +11,14 @@ fusion alone cannot recover:
     low-precision down-cast). The standalone batch_norm reads the conv
     output back from HBM for its E[x]/E[x^2] reductions — measured at
     17-35% of ResNet-50 stage time (PERF.md r5, tools/_rn_diag.py).
+  * fuse_epilogue_act (ISSUE 9): norm -> relu and norm -> residual-add ->
+    relu chains collapse into the norm op (attr `act`, input `Residual`),
+    whose lowering then dispatches the WHOLE apply chain through the
+    fused-epilogue tuner lever (ops/nn_ops._bn_epilogue) — one Pallas
+    kernel visit where a swept verdict keeps it, the bit-identical XLA
+    composition everywhere else. This is the structural half of the
+    ResNet BN/elementwise-tail attack: without the rewrite, the residual
+    add and the relu live in other ops and the kernel has nothing to fuse.
 
 Runs at minimize() time, before append_backward (the fused op's gradient
 derives via vjp over the fused lowering) and after any AMP rewrite (so the
@@ -21,7 +29,8 @@ from __future__ import annotations
 
 from . import flags
 
-__all__ = ["fuse_conv_bn_stats", "apply_minimize_passes"]
+__all__ = ["fuse_conv_bn_stats", "fuse_epilogue_act",
+           "apply_minimize_passes"]
 
 
 def _writes(op, name: str) -> bool:
@@ -171,6 +180,150 @@ def fuse_conv_bn_stats(program) -> int:
     return n_fused
 
 
+# norm ops the epilogue rewrite folds a trailing activation into, and the
+# activations the fused lowering (ops/nn_ops._EPILOGUE_ACTS) can carry
+_EPILOGUE_NORM_OPS = ("batch_norm", "conv2d_bn", "layer_norm")
+_EPILOGUE_ACT_OPS = ("relu",)
+
+
+def _sole_reader(block, producer, out_name: str):
+    """(block_idx, op) of the single op reading `out_name`, or None — and
+    None as well if anything else WRITES it (the var must disappear
+    cleanly when the chain collapses)."""
+    readers = []
+    for b in block.program.blocks:
+        for i, op in enumerate(b.ops):
+            if op is not producer and _reads(op, out_name):
+                readers.append((b, i, op))
+            if op is not producer and _writes(op, out_name):
+                return None
+    if len(readers) != 1 or readers[0][0] is not block:
+        return None
+    return readers[0][1], readers[0][2]
+
+
+def _inputs_stable(block, names, lo: int, hi: int) -> bool:
+    """No op in block.ops(lo, hi] redefines any of `names` (the fused op is
+    moved to position hi, so every input must still hold its value there)."""
+    for mid in block.ops[lo + 1:hi + 1]:
+        if any(_writes(mid, n) for n in names):
+            return False
+    return True
+
+
+def fuse_epilogue_act(program) -> int:
+    """Collapse norm -> [same-shape residual add ->] relu chains into the
+    norm op. Returns the number of chains fused.
+
+    Two patterns, both requiring every intermediate var to have exactly one
+    reader (it vanishes from the graph):
+      * norm -> relu:          norm gains attr act, adopts relu's output.
+      * norm -> add -> relu:   norm additionally gains input Residual (the
+        add's other operand) and MOVES to the relu's position — the
+        residual branch (e.g. a ResNet shortcut conv) is built after the
+        main branch, so its value does not exist at the norm's old index.
+    """
+    n_fused = 0
+    for block in program.blocks:
+        i = 0
+        while i < len(block.ops):
+            norm = block.ops[i]
+            if norm.type not in _EPILOGUE_NORM_OPS or norm.attr("act", ""):
+                i += 1
+                continue
+            y_name = norm.output("Y")[0]
+            hit = _sole_reader(block, norm, y_name)
+            if hit is None:
+                i += 1
+                continue
+            j, consumer = hit
+            if j <= i:
+                i += 1
+                continue
+            norm_inputs = [n for ns in norm.inputs.values() for n in ns]
+            if consumer.type in _EPILOGUE_ACT_OPS:
+                if not _inputs_stable(block, norm_inputs, i, j - 1):
+                    i += 1
+                    continue
+                norm.attrs["act"] = consumer.type
+                norm.outputs["Y"] = list(consumer.output("Out"))
+                del block.ops[j]
+                n_fused += 1
+                continue  # re-examine i: the fused op could chain further
+            if consumer.type != "elementwise_add" or norm.type == "layer_norm":
+                # the residual-add fold exists for the BN apply kernels;
+                # layer_norm's lowering carries no Residual slot
+                i += 1
+                continue
+            # residual pattern: the add must be same-shape (axis -1/0) and
+            # feed exactly one relu
+            xs, ys = consumer.input("X"), consumer.input("Y")
+            if len(xs) != 1 or len(ys) != 1:
+                i += 1
+                continue
+            other = ys[0] if xs[0] == y_name else xs[0]
+            if other == y_name:
+                i += 1
+                continue
+            try:
+                if (tuple(block.var(other).shape)
+                        != tuple(block.var(y_name).shape)):
+                    i += 1
+                    continue
+            except KeyError:
+                i += 1
+                continue
+            if consumer.attr("axis", -1) not in (-1, 0):
+                i += 1
+                continue
+            add_out = consumer.output("Out")[0]
+            hit2 = _sole_reader(block, consumer, add_out)
+            if hit2 is None:
+                i += 1
+                continue
+            k, act_op = hit2
+            if act_op.type not in _EPILOGUE_ACT_OPS or k <= j:
+                i += 1
+                continue
+            if not _inputs_stable(block, norm_inputs, i, k) or \
+                    not _inputs_stable(block, [other], j, k):
+                i += 1
+                continue
+            norm.attrs["act"] = act_op.type
+            norm.inputs["Residual"] = [other]
+            norm.outputs["Y"] = list(act_op.output("Out"))
+            # move the fused op to the relu's slot (the residual operand is
+            # defined by then); drop relu, add, and the original position
+            inputs = {s: list(ns) for s, ns in norm.inputs.items()}
+            outputs = {s: list(ns) for s, ns in norm.outputs.items()}
+            attrs = dict(norm.attrs)
+            del block.ops[k]
+            block._insert_op(k, norm.type, inputs, outputs, attrs)
+            del block.ops[j]
+            del block.ops[i]
+            n_fused += 1
+            # stay at i: the next op shifted into this slot
+    if n_fused:
+        program._bump_version()
+    return n_fused
+
+
+def _epilogue_pass_wanted() -> bool:
+    """The rewrite runs when the fused lowering could ever pick the kernel:
+    FLAGS_pallas_epilogue 'on' (forced A/B arms), or 'auto' with the tuner
+    consulting/sweeping (a swept DB verdict is the only thing that turns
+    the kernel on — the r5 ships-off-by-default rule). With tuning off the
+    program keeps its exact pre-workbench structure."""
+    mode = str(flags.get_flag("pallas_epilogue")).strip().lower()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    from . import tuning
+
+    return tuning.mode() != "off"
+
+
 def apply_minimize_passes(program) -> None:
     """Flag-gated pass pipeline run once per minimize()/backward() on the
     main program (optimizer.Optimizer.backward — the single choke point both
@@ -179,3 +332,7 @@ def apply_minimize_passes(program) -> None:
             program, "_bn_stats_fused", False):
         program._bn_stats_fused = True  # idempotent across re-entry
         fuse_conv_bn_stats(program)
+    if _epilogue_pass_wanted() and not getattr(
+            program, "_epilogue_fused", False):
+        program._epilogue_fused = True  # idempotent across re-entry
+        fuse_epilogue_act(program)

@@ -33,7 +33,8 @@ import warnings
 DB_SCHEMA = 1
 
 __all__ = ["DB_SCHEMA", "TuningDB", "canonical_key", "conv_key",
-           "attention_key", "bucket_key", "amp_key", "collective_key"]
+           "attention_key", "bucket_key", "amp_key", "collective_key",
+           "epilogue_key", "xent_key"]
 
 
 def canonical_key(op: str, shape_key: str, dtype: str, device_kind: str) -> str:
@@ -62,6 +63,24 @@ def bucket_key(var_name: str, dim: int, raw_extent: int) -> str:
     """Shape-bucketing boundary decisions: which padded extent a raw ragged
     extent rounds to (recorded so sweeps can revisit the pow2 default)."""
     return f"var={var_name} dim={dim} raw={raw_extent}"
+
+
+def epilogue_key(kind: str, rows: int, channels: int, channel_pos: str,
+                 act: str, has_residual: bool) -> str:
+    """Fused normalize+affine+activation epilogue decisions
+    (ops/pallas_kernels/epilogue.py): keyed on the canonical 2-D problem
+    the kernel sees — reduction row count x channel extent — plus the
+    layout ('last' = NHWC channels-last, 'row' = NCHW channels-row), the
+    fused activation, and whether a residual add rides along. kind is
+    'bn' (apply given stats) or 'ln' (in-kernel row statistics)."""
+    return (f"kind={kind} rows={rows} c={channels} ch={channel_pos} "
+            f"act={act or 'identity'} res={int(bool(has_residual))}")
+
+
+def xent_key(rows: int, vocab: int) -> str:
+    """Fused softmax-xent decisions (ops/pallas_kernels/xent.py): the
+    kernel's problem is the flattened [rows, vocab] logits tile."""
+    return f"rows={rows} v={vocab}"
 
 
 def amp_key(op_type: str) -> str:

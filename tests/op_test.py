@@ -88,6 +88,37 @@ class OpTest:
                 err_msg=f"{self.op_type} output {name}",
             )
 
+    def _out_array(self, output_name):
+        for slot, v in self.expected.items():
+            if isinstance(v, list):
+                for n, arr in v:
+                    if n == output_name or slot == output_name:
+                        return np.asarray(arr)
+            elif slot == output_name or f"{slot}_out" == output_name:
+                return np.asarray(v)
+        raise KeyError(output_name)
+
+    def _reduce_target(self, block, out_var, feed, weighted):
+        """The scalar the gradient check differentiates: sum(out), or —
+        with `weighted` — sum(W (.) out) for a fixed seeded W. The weighted
+        form exists for ops whose plain sum is a DEGENERATE functional
+        (sum(softmax) == n_rows identically, so its true gradient is zero
+        everywhere and the check compares nothing but fp32 noise against
+        the 1e-3 denominator floor); weighting makes the checked gradient
+        non-trivial while both the analytic and numeric sides see the same
+        scalar."""
+        from paddle_tpu import layers as L
+
+        if not weighted:
+            return L.reduce_sum(out_var)
+        wname = "__grad_check_w"
+        arr = self._out_array(self._weight_ref_name)
+        block.create_var(name=wname, shape=arr.shape, dtype="float32",
+                         is_data=True, stop_gradient=True)
+        wrng = np.random.default_rng(1234)
+        feed[wname] = wrng.standard_normal(arr.shape).astype(np.float32)
+        return L.reduce_sum(L.elementwise_mul(out_var, block.var(wname)))
+
     def check_grad(
         self,
         inputs_to_check: list[str],
@@ -95,30 +126,33 @@ class OpTest:
         numeric_delta=5e-3,
         max_relative_error=5e-3,
         no_grad_set=None,
+        weighted=False,
     ):
         """Analytic grads (append_backward over a sum-reduced output) vs
-        numeric central differences of the same scalar."""
+        numeric central differences of the same scalar. `weighted=True`
+        reduces with a fixed seeded weighting instead of a plain sum (see
+        _reduce_target) — required for ops like softmax whose row sums are
+        constant."""
+        self._weight_ref_name = output_name
         main, startup, feed, out_names = self._build()
         with pt.program_guard(main, startup):
             block = main.global_block
             out_var = block.var(self._out_name(output_name, out_names))
-            from paddle_tpu import layers as L
-
-            target = L.reduce_sum(out_var)
+            target = self._reduce_target(block, out_var, feed, weighted)
             pt.append_backward(target, parameter_list=[], no_grad_set=no_grad_set or set())
         exe = pt.Executor()
         exe.run(startup)
         grad_names = [grad_var_name(n) for n in inputs_to_check]
         analytic = exe.run(main, feed=feed, fetch_list=grad_names)
 
-        # numeric: d sum(out) / d in via central differences
-        fetch_scalar_main, fetch_startup, _, o2 = self._build()
+        # numeric: d target / d in via central differences
+        fetch_scalar_main, fetch_startup, feed2, o2 = self._build()
         with pt.program_guard(fetch_scalar_main, fetch_startup):
-            from paddle_tpu import layers as L
-
             block = fetch_scalar_main.global_block
             out_var = block.var(self._out_name(output_name, o2))
-            target2 = L.reduce_sum(out_var)
+            target2 = self._reduce_target(block, out_var, feed2, weighted)
+        if weighted:
+            feed["__grad_check_w"] = feed2["__grad_check_w"]
         exe2 = pt.Executor()
         exe2.run(fetch_startup)
 

@@ -74,17 +74,13 @@ def test_ring_attention_matches_full_attention():
     kv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
     vv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
 
-    try:
-        from jax import shard_map as shard_map_fn
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as shard_map_fn
+    from paddle_tpu.ops.collective_ops import compat_shard_map as shard_map_fn
 
     fn = shard_map_fn(
         lambda q, k, v: ring_attention_local(q, k, v, "sp", sm_scale=dh ** -0.5),
         mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3,
         out_specs=P(None, None, "sp", None),
-        check_vma=False,
     )
     got = np.asarray(jax.jit(fn)(qv, kv, vv))
     want = _np_attention(qv, kv, vv)
@@ -104,17 +100,13 @@ def test_ring_attention_causal_matches():
     qv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
     kv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
     vv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
-    try:
-        from jax import shard_map as shard_map_fn
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as shard_map_fn
+    from paddle_tpu.ops.collective_ops import compat_shard_map as shard_map_fn
 
     fn = shard_map_fn(
         lambda q, k, v: ring_attention_local(q, k, v, "sp", causal=True, sm_scale=dh ** -0.5),
         mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3,
         out_specs=P(None, None, "sp", None),
-        check_vma=False,
     )
     got = np.asarray(jax.jit(fn)(qv, kv, vv))
     want = _np_attention(qv, kv, vv, causal=True)
@@ -137,17 +129,13 @@ def test_ring_attention_grads():
     qv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
     kv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
     vv = rng.standard_normal((B, nh, S, dh)).astype(np.float32)
-    try:
-        from jax import shard_map as shard_map_fn
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as shard_map_fn
+    from paddle_tpu.ops.collective_ops import compat_shard_map as shard_map_fn
 
     ring = shard_map_fn(
         lambda q, k, v: ring_attention_local(q, k, v, "sp", sm_scale=dh ** -0.5),
         mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3,
         out_specs=P(None, None, "sp", None),
-        check_vma=False,
     )
     g_ring = jax.grad(lambda q: jax.jit(ring)(q, kv, vv).sum())(qv)
     g_full = jax.grad(

@@ -97,18 +97,26 @@ def test_resnet_s2d_stem_fold_equivalence():
 def test_resnet_nhwc_matches_nchw():
     """data_format="NHWC" is a pure layout change: same params (weights
     stay OIHW), same function. Run one small trunk both ways with shared
-    initial weights and compare logits."""
+    initial weights and compare logits.
+
+    Input is 64x64, not 32x32: at 32x32 the depth-18 trunk's deepest stage
+    collapses to 1x1 spatial, so each BN normalizes over exactly N=2
+    samples per channel — sigma is |x1-x2|/2 and the normalize amplifies
+    the conv's layout-dependent last-bit reduction-order differences by
+    |x|/sigma (measured blowup 4e-4 -> 3e-2 through stage 4, the
+    pre-existing tier-1 failure). At 64x64 the deepest stage keeps 2x2
+    spatial and the two layouts match bitwise on this backend."""
     from paddle_tpu import layers as L
 
     rng = np.random.default_rng(7)
-    x_nchw = rng.standard_normal((2, 3, 32, 32)).astype(np.float32)
+    x_nchw = rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
     x_nhwc = np.ascontiguousarray(x_nchw.transpose(0, 2, 3, 1))
     exe = pt.Executor()
     outs, params = {}, {}
     for fmt in ("NCHW", "NHWC"):
         guard, main, startup = _fresh_programs()
         with guard:
-            shape = [3, 32, 32] if fmt == "NCHW" else [32, 32, 3]
+            shape = [3, 64, 64] if fmt == "NCHW" else [64, 64, 3]
             img = L.data(name="img", shape=shape, dtype="float32")
             logits = resnet.resnet(img, depth=18, num_classes=5,
                                    data_format=fmt)

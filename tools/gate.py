@@ -16,6 +16,9 @@ every end-of-round snapshot commit:
     python tools/gate.py --chaos           # chaos smoke only (`-m chaos`:
                                            # fault-injection + SIGKILL-
                                            # trainer liveness subset)
+    python tools/gate.py --kernels         # Pallas kernel-registry lint
+                                           # only (reference + equivalence
+                                           # test + tuner key per kernel)
 """
 from __future__ import annotations
 
@@ -90,6 +93,89 @@ def run_chaos() -> int:
         print("[gate] FAIL: chaos smoke is red — the resilience/liveness "
               "runtime regressed", flush=True)
     return r.returncode
+
+
+def check_kernel_registry() -> int:
+    """Pallas kernel-workbench lint (ISSUE 9): every registered kernel must
+    carry (1) a callable XLA reference, (2) a shape gate, (3) a tuning-DB
+    decision op with a real key speller, and (4) an equivalence test that
+    actually exists in tests/ — an unmeasured or unreferenced kernel cannot
+    land silently (the keep-or-retire contract made structural)."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu import tuning
+    from paddle_tpu.ops.pallas_kernels import all_kernels
+
+    # decision op -> the tuning key speller that proves the op is wired
+    key_spellers = {
+        "attention": tuning.attention_key,
+        "epilogue": tuning.epilogue_key,
+        "conv2d": tuning.conv_key,
+        "xent": tuning.xent_key,
+    }
+    test_defs = []
+    for path in glob.glob(os.path.join(REPO, "tests", "*.py")):
+        with open(path) as f:
+            test_defs.append(f.read())
+    blob = "\n".join(test_defs)
+    rc = 0
+    for name, spec in sorted(all_kernels().items()):
+        problems = []
+        if not callable(spec.reference):
+            problems.append("no XLA reference")
+        if not callable(spec.supported):
+            problems.append("no supported() shape gate")
+        if spec.decision_op not in key_spellers:
+            problems.append(
+                f"decision_op {spec.decision_op!r} has no tuning key "
+                f"speller (known: {sorted(key_spellers)})")
+        test = spec.equivalence_test or ""
+        if not test or f"def {test}" not in blob:
+            problems.append(
+                f"equivalence test {test!r} not defined under tests/")
+        if problems:
+            print(f"[gate] FAIL: pallas kernel '{name}': "
+                  + "; ".join(problems), flush=True)
+            rc = 1
+        else:
+            print(f"[gate] kernel registry: '{name}' ok "
+                  f"(op={spec.decision_op}, test={test})", flush=True)
+    return rc
+
+
+def _check_kernel_ab(data: dict, label: str) -> int:
+    """ISSUE 9 acceptance: a kernel arm that ENGAGED (its Pallas kernel
+    actually carried the op) and lost to its kernel-off baseline beyond the
+    interference band fails the gate — a kept kernel must keep earning its
+    verdict end-to-end every round. Un-engaged arms (CPU rounds: dispatch
+    degraded to XLA) are informational only."""
+    rc = 0
+    ab = data.get("bert_s128_shortattn_ab")
+    if isinstance(ab, dict) and ab.get("verdict"):
+        print(f"[gate] bench {label}: s128 short-attn A/B xla "
+              f"{ab.get('xla_tok_s')} vs pallas {ab.get('pallas_tok_s')} "
+              f"tok/s ({ab.get('verdict')}, engaged {ab.get('engaged')}, "
+              f"band {ab.get('band')})", flush=True)
+        if ab.get("engaged") and ab.get("verdict") == "retire":
+            print("[gate] FAIL: the engaged pallas_short128 attention arm "
+                  "lost to XLA beyond the interference band — retire the "
+                  "swept keep (tools/tune.py --what attention) or fix the "
+                  "kernel before snapshotting", flush=True)
+            rc = 1
+    rn = data.get("resnet50_lever_ab")
+    if isinstance(rn, dict) and rn.get("epilogue_verdict"):
+        print(f"[gate] bench {label}: resnet epilogue arm "
+              f"{rn.get('epilogue_img_s')} img/s vs levered "
+              f"{rn.get('levered_img_s')} ({rn.get('epilogue_verdict')}, "
+              f"engaged {rn.get('epilogue_engaged')}, "
+              f"band {rn.get('epilogue_band')})", flush=True)
+        if rn.get("epilogue_engaged") and \
+                rn.get("epilogue_verdict") == "retire":
+            print("[gate] FAIL: the engaged fused-epilogue arm lost to its "
+                  "kernel-off baseline beyond the interference band — "
+                  "retire the swept keeps (tools/tune.py --what epilogue) "
+                  "or fix the kernel before snapshotting", flush=True)
+            rc = 1
+    return rc
 
 
 def run_entry() -> int:
@@ -332,6 +418,8 @@ def check_bench(path: str | None = None) -> int:
         return 0
     if _check_resnet_regression(data, prev_path, os.path.basename(path)):
         return 1
+    if _check_kernel_ab(data, os.path.basename(path)):
+        return 1
     if _check_tuner_coverage(data, os.path.basename(path)):
         return 1
     if _check_serving(data, prev_path, os.path.basename(path)):
@@ -374,9 +462,12 @@ def main() -> int:
         return check_multichip(arg[0] if arg else None)
     if "--chaos" in sys.argv:
         return run_chaos()
+    if "--kernels" in sys.argv:
+        return check_kernel_registry()
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
+        rc = rc or check_kernel_registry()
         rc = rc or check_bench()
         rc = rc or check_multichip()
     if rc == 0:

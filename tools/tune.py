@@ -13,12 +13,19 @@ Sweeps:
   conv       — direct vs implicit-GEMM lowering per conv shape (default
                set: the PERF.md r6 ResNet-50 cost-table shapes; add yours
                with repeated --conv-shape n,h,w,cin,cout,kh,kw,sh,sw).
-  attention  — XLA einsum composition vs the short-seq Pallas kernel vs
-               the bundled flash kernel per (batch, heads, seq, head_dim)
-               (default: the bench.py BERT s128 and s512 configs). Arms a
-               platform cannot run (Pallas off-TPU) are skipped.
-  candidates — every `candidate` conv2d entry a FLAGS_tuning_mode=sweep
-               run recorded into the DB gets measured and upgraded.
+  attention  — XLA einsum composition vs the short-seq Pallas kernels
+               (seq<=128 and the 128-multiple kernel) vs the bundled flash
+               kernel per (batch, heads, seq, head_dim) (default: the
+               bench.py BERT s128 and s512 configs). Arms a platform
+               cannot run (Pallas off-TPU) are skipped.
+  epilogue   — XLA composition vs the fused normalize+affine+act(+residual)
+               Pallas kernel (ops/pallas_kernels/epilogue.py) over the
+               PERF.md r6 cost-table conv OUTPUT shapes (the BN apply tail,
+               NHWC + NCHW, with and without residual) and the bench BERT
+               s128 layer-norm rows.
+  candidates — every `candidate` conv2d / attention / epilogue entry a
+               FLAGS_tuning_mode=sweep run recorded into the DB gets
+               measured and upgraded.
 
 These are per-shape microbenches — TVM-style schedule search, deliberately
 NOT the chained-per-op instrument PERF.md retired (each arm here is one
@@ -79,6 +86,21 @@ DECODE_ATTENTION_SHAPES = [
     ("decode_b8_kv1024", 8, 12, 1024, 64),
     ("decode_b32_kv512", 32, 12, 512, 64),
     ("decode_b64_kv2048", 64, 12, 2048, 64),
+]
+
+
+# the epilogue lever's sweep set (ISSUE 9): the BN apply tail of the
+# PERF.md r6 cost-table conv OUTPUT shapes — (name, batch, channels,
+# spatial) — expanded over layout x residual below; plus the bench BERT
+# s128 LN rows. These are the shapes bench.py's resnet/bert arms dispatch.
+EPILOGUE_BN_SHAPES = [
+    ("stem_7x7_out", 128, 64, 112 * 112),
+    ("s0_3x3_out", 128, 64, 56 * 56),
+    ("s1_3x3_out", 128, 128, 28 * 28),
+]
+
+EPILOGUE_LN_SHAPES = [
+    ("bert_s128_ln", 128 * 128, 768),
 ]
 
 
@@ -178,6 +200,7 @@ def sweep_conv(db, shapes, dtype: str, iters: int, passes: int, band: float,
 def sweep_attention(db, shapes, dtype: str, iters: int, passes: int,
                     band: float):
     from paddle_tpu.ops.attention_ops import (_flash_bundled_ok,
+                                              _pallas_short128_ok,
                                               _pallas_short_ok,
                                               _reference_attention)
 
@@ -205,6 +228,13 @@ def sweep_attention(db, shapes, dtype: str, iters: int, passes: int,
                                       psa.short_seq_attention(
                                           qq, kk, vv, causal=causal,
                                           sm_scale=sm))
+        if _pallas_short128_ok(q.shape, k.shape, None):
+            from paddle_tpu.ops.pallas_kernels import short_attention as s128
+
+            arms["pallas_short128"] = mk(lambda qq, kk, vv:
+                                         s128.short128_attention(
+                                             qq, kk, vv, causal=causal,
+                                             sm_scale=sm))
         if _flash_bundled_ok(q.shape, k.shape, q.dtype):
             from jax.experimental.pallas.ops.tpu import flash_attention as fa
 
@@ -285,6 +315,101 @@ def sweep_decode_attention(db, shapes, dtype: str, iters: int, passes: int,
                           "verdict": verdict}), flush=True)
 
 
+def sweep_epilogue(db, bn_shapes, ln_shapes, dtype: str, iters: int,
+                   passes: int, band: float):
+    """The fused-epilogue lever's sweep (ISSUE 9): XLA composition vs the
+    Pallas apply kernel per canonical (rows, channels, layout, act,
+    residual) problem — fwd+bwd jitted, one arm-set per BN shape over
+    (NHWC no-res, NHWC res, NCHW res) plus the LN rows. Keys are exactly
+    what ops/nn_ops._epilogue_backend consults, so a swept keep here IS
+    the dispatch for that shape. Shapes whose Pallas arm cannot run on
+    this platform are skipped, not recorded — absence of a verdict keeps
+    the analytic XLA prior, which is already the off state."""
+    jobs = []
+    for name, n, c, hw in bn_shapes:
+        jobs.append((f"{name}_nhwc", "bn", (n * hw, c), "last", "relu",
+                     False))
+        jobs.append((f"{name}_nhwc_res", "bn", (n * hw, c), "last", "relu",
+                     True))
+        jobs.append((f"{name}_nchw_res", "bn", (n, c, hw), "row", "relu",
+                     True))
+    for name, rows, k in ln_shapes:
+        jobs.append((name, "ln", (rows, k), "last", "identity", False))
+    _sweep_epilogue_jobs(db, jobs, dtype, iters, passes, band)
+
+
+def _sweep_epilogue_jobs(db, jobs, dtype: str, iters: int, passes: int,
+                         band: float):
+    from paddle_tpu.ops.pallas_kernels import epilogue as ep
+    from paddle_tpu.ops.pallas_kernels import workbench
+    from paddle_tpu import tuning as _t
+
+    key_dtype = str(jnp.dtype(dtype))
+    for name, kind, shape, cpos, act, has_res in jobs:
+        rng = np.random.default_rng(0)
+        cl = cpos == "last"
+        C = shape[-1] if cl else shape[1]
+        rows = int(np.prod(shape)) // C
+        x = jax.device_put(rng.standard_normal(
+            shape, dtype=np.float32).astype(dtype))
+        res = jax.device_put(rng.standard_normal(
+            shape, dtype=np.float32).astype(dtype)) if has_res else None
+        s, b = (jax.device_put(rng.standard_normal(C).astype(np.float32))
+                for _ in range(2))
+        m = jax.device_put(rng.standard_normal(C).astype(np.float32))
+        v = jax.device_put((np.abs(rng.standard_normal(C)) + 0.5)
+                           .astype(np.float32))
+
+        def mk(fn, wants_res):
+            if wants_res:
+                def loss(xx, rr):
+                    return jnp.sum(jnp.square(fn(xx, rr)
+                                              .astype(jnp.float32)))
+                g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+                return lambda: g(x, res)[0]
+
+            def loss(xx):
+                return jnp.sum(jnp.square(fn(xx).astype(jnp.float32)))
+            g = jax.jit(jax.grad(loss))
+            return lambda: g(x)
+
+        if kind == "bn":
+            arms = {"xla": mk(lambda xx, rr=None: ep.bn_apply_act_reference(
+                xx, s, b, m, v, act=act, residual=rr, channel_last=cl),
+                has_res)}
+            if workbench.runnable(ep) and ep.epilogue_supported(
+                    shape, jnp.dtype(dtype), cl, act):
+                arms["pallas"] = mk(
+                    lambda xx, rr=None: ep.bn_apply_act(
+                        xx, s, b, m, v, act=act, residual=rr,
+                        channel_last=cl), has_res)
+        else:
+            arms = {"xla": mk(lambda xx: ep.layer_norm_act_reference(
+                xx, s, b, act=act), False)}
+            if workbench.runnable(ep) and ep.epilogue_supported(
+                    shape, jnp.dtype(dtype), True, act):
+                arms["pallas"] = mk(lambda xx: ep.layer_norm_act(
+                    xx, s, b, act=act), False)
+        print(json.dumps({"sweep": "epilogue", "shape": name,
+                          "arms": sorted(arms)}), flush=True)
+        if len(arms) < 2:
+            print(json.dumps({"shape": name, "skipped":
+                              "only the XLA arm runs on this platform"}),
+                  flush=True)
+            continue
+        measured = _measure_arms(arms, iters, passes)
+        backend, verdict = _verdict_vs_base(measured, "xla", band)
+        key = _t.canonical_key(
+            "epilogue", _t.epilogue_key(kind, rows, C, cpos, act, has_res),
+            key_dtype, _t.device_kind())
+        db.put(key, {"backend": backend}, source="swept",
+               measured={a: {"median_s": mm["median_s"], "band": mm["band"]}
+                         for a, mm in measured.items()},
+               note=f"{name}: verdict={verdict}")
+        print(json.dumps({"shape": name, "decision": backend,
+                          "verdict": verdict}), flush=True)
+
+
 _CONV_KEY_RE = re.compile(
     r"^conv2d\|n=(\d+) out=(\d+)x(\d+) cin=(\d+) cout=(\d+) k=(\d+)x(\d+) "
     r"s=(\d+)x(\d+) d=(\d+)x(\d+) (NHWC|NCHW)\|([\w.]+)\|")
@@ -293,6 +418,11 @@ _CONV_KEY_RE = re.compile(
 _ATTN_KEY_RE = re.compile(
     r"^attention\|b=(\d+) nh=(\d+) sq=(\d+) sk=(\d+) dh=(\d+) "
     r"causal=(\d)\|([\w.]+)\|")
+
+
+_EPI_KEY_RE = re.compile(
+    r"^epilogue\|kind=(\w+) rows=(\d+) c=(\d+) ch=(last|row) act=(\w+) "
+    r"res=(\d)\|([\w.]+)\|")
 
 
 def sweep_candidates(db, iters, passes, band):
@@ -306,6 +436,7 @@ def sweep_candidates(db, iters, passes, band):
     identical either way."""
     attn_groups: dict[str, list] = {}
     decode_groups: dict[str, list] = {}
+    epi_groups: dict[str, tuple[list, list]] = {}
     for ckey, entry in sorted(db.entries.items()):
         if entry.get("source") != "candidate":
             continue
@@ -320,10 +451,36 @@ def sweep_candidates(db, iters, passes, band):
                 attn_groups.setdefault(dt, []).append(
                     (f"candidate_b{b}_s{sq}", b, nh, sq, dh_, bool(causal)))
             continue
+        em = _EPI_KEY_RE.match(ckey)
+        if em:
+            kind, rows, c = em.group(1), int(em.group(2)), int(em.group(3))
+            cpos, act, has_res = em.group(4), em.group(5), int(em.group(6))
+            dt = em.group(7)
+            bn_s, ln_s = epi_groups.setdefault(dt, ([], []))
+            # sweep_epilogue regenerates the (layout, residual) expansion
+            # from a compact shape row, so reconstruct one matching row:
+            # channels-last rows collapse to (n=1, c, hw=rows); channels-row
+            # keys carry rows = n (per-image spatial folded into hw)
+            if kind == "ln":
+                ln_s.append((f"candidate_ln_{rows}x{c}", rows, c))
+            else:
+                bn_s.append((f"candidate_bn_{rows}x{c}", kind, rows, c,
+                             cpos, act, bool(has_res)))
+            continue
     for dt, shapes in sorted(attn_groups.items()):
         sweep_attention(db, shapes, dt, iters, passes, band)
     for dt, shapes in sorted(decode_groups.items()):
         sweep_decode_attention(db, shapes, dt, iters, passes, band)
+    for dt, (bn_s, ln_s) in sorted(epi_groups.items()):
+        # channels-row keys fold the (N, HW) split into rows = N*HW; the
+        # re-measured tensor uses N=1 — total elements (what the apply cost
+        # scales with) are preserved, only the param-tiling split differs
+        jobs = [(nm, kind, ((rows, c) if cpos == "last" else (1, c, rows)),
+                 cpos, act, has_res)
+                for nm, kind, rows, c, cpos, act, has_res in bn_s]
+        jobs += [(nm, "ln", (rows, c), "last", "identity", False)
+                 for nm, rows, c in ln_s]
+        _sweep_epilogue_jobs(db, jobs, dt, iters, passes, band)
 
     rows = []
     for ckey, entry in sorted(db.entries.items()):
@@ -355,8 +512,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--db", default=os.environ.get("FLAGS_tuning_db",
                                                    "TUNING_DB.json"))
-    ap.add_argument("--what", default="conv,attention",
-                    help="comma list: conv, attention, candidates")
+    ap.add_argument("--what", default="conv,attention,epilogue",
+                    help="comma list: conv, attention, epilogue, candidates")
     on_tpu = jax.devices()[0].platform == "tpu"
     ap.add_argument("--iters", type=int, default=20 if on_tpu else 3)
     ap.add_argument("--passes", type=int, default=3 if on_tpu else 2)
@@ -369,6 +526,8 @@ def main():
     conv_shapes = RN50_CONV_SHAPES
     attn_shapes = ATTENTION_SHAPES
     decode_shapes = DECODE_ATTENTION_SHAPES
+    epi_bn_shapes = EPILOGUE_BN_SHAPES
+    epi_ln_shapes = EPILOGUE_LN_SHAPES
     if args.small or not on_tpu:
         conv_shapes = [(nm, 8, h // 4, w // 4, ci, co, kh, kw, st, pd, d)
                        for nm, _, h, w, ci, co, kh, kw, st, pd, d
@@ -377,6 +536,10 @@ def main():
                        for nm, _, nh, s, dh, c in ATTENTION_SHAPES]
         decode_shapes = [(nm, 2, nh, kv // 4, dh)
                          for nm, _, nh, kv, dh in DECODE_ATTENTION_SHAPES]
+        epi_bn_shapes = [(nm, 2, c, hw // 16)
+                         for nm, _, c, hw in EPILOGUE_BN_SHAPES]
+        epi_ln_shapes = [(nm, rows // 64, k)
+                         for nm, rows, k in EPILOGUE_LN_SHAPES]
 
     db = tuning.TuningDB(args.db)
     what = {w.strip() for w in args.what.split(",") if w.strip()}
@@ -390,6 +553,9 @@ def main():
         # op kind, same DB namespace, different (sq=1) shape family
         sweep_decode_attention(db, decode_shapes, args.dtype, args.iters,
                                args.passes, args.band)
+    if "epilogue" in what:
+        sweep_epilogue(db, epi_bn_shapes, epi_ln_shapes, args.dtype,
+                       args.iters, args.passes, args.band)
     if "candidates" in what:
         sweep_candidates(db, args.iters, args.passes, args.band)
     db.save(args.db)
