@@ -554,6 +554,208 @@ def bench_deepfm(on_tpu: bool):
             guard_overhead_pct)
 
 
+def _tiered_parity(steps: int = 12):
+    """Small-scale parameter-parity oracle for the tiered path (ISSUE 10):
+    same model, same inits, same batches — N SGD steps through a 256-slot
+    cache over a 512-row table (evictions + write-backs fire constantly)
+    vs the dense-lookup program. Returns the max |param| drift; tools/
+    gate.py hard-fails above 1e-4 (measured: float associativity only)."""
+    import paddle_tpu as pt
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu import layers as L
+    from paddle_tpu.layers import tensor as T
+    from paddle_tpu.param_attr import ParamAttr
+
+    VOCAB, DIM, FIELDS, BATCH = 512, 8, 6, 32
+
+    def build():
+        ids = T.data(name="ids", shape=[FIELDS], dtype="int64")
+        label = T.data(name="label", shape=[1], dtype="float32")
+        emb = L.embedding(ids, size=[VOCAB, DIM], is_sparse=True,
+                          param_attr=ParamAttr(name="ptbl"))
+        pooled = L.reduce_sum(emb, dim=1)
+        logit = L.fc(pooled, size=1, param_attr=ParamAttr(name="pw"),
+                     bias_attr=ParamAttr(name="pb"))
+        return L.mean(L.sigmoid_cross_entropy_with_logits(logit, label))
+
+    def feed(s):
+        rng = np.random.default_rng(500 + s)
+        return {"ids": rng.integers(0, VOCAB,
+                                    (BATCH, FIELDS)).astype(np.int64),
+                "label": rng.integers(0, 2, (BATCH, 1)).astype(np.float32)}
+
+    def minimized(budget, slots):
+        m, st = pt.Program(), pt.Program()
+        m.random_seed = st.random_seed = 7
+        pt_flags.set_flags({"emb_hbm_budget_mb": budget,
+                            "emb_cache_slots": slots})
+        with pt.program_guard(m, st), pt.unique_name.guard():
+            loss = build()
+            pt.optimizer.SGD(0.1).minimize(loss)
+        return m, st, loss
+
+    saved = {k: pt_flags.get_flag(k)
+             for k in ("emb_hbm_budget_mb", "emb_cache_slots")}
+    try:
+        exe = pt.Executor()
+        main_o, startup_o, loss_o = minimized(0.0, 0)
+        sc_o = pt.Scope()
+        with pt.scope_guard(sc_o):
+            exe.run(startup_o)
+            init = {n: np.array(np.asarray(sc_o.find_var(n)))
+                    for n in ("ptbl", "pw", "pb")}
+            for s in range(steps):
+                exe.run(main_o, feed=feed(s), fetch_list=[loss_o])
+            oracle = {n: np.asarray(sc_o.find_var(n))
+                      for n in ("ptbl", "pw", "pb")}
+
+        main_t, startup_t, loss_t = minimized(0.001, 256)
+        eng = main_t._tiered_engine
+        sc_t = pt.Scope()
+        with pt.scope_guard(sc_t):
+            exe.run(startup_t)
+            eng.tables["ptbl"].host.load_rows(np.arange(VOCAB),
+                                              init["ptbl"])
+            eng.tables["ptbl"].host.clear_dirty()
+            sc_t.set_var("pw", jax.device_put(init["pw"]))
+            sc_t.set_var("pb", jax.device_put(init["pb"]))
+            for s in range(steps):
+                exe.run(main_t, feed=feed(s), fetch_list=[loss_t])
+            exe.wait()
+            table_t = eng.export_dense("ptbl", sc_t)
+            drift = max(
+                float(np.abs(table_t - oracle["ptbl"]).max()),
+                float(np.abs(np.asarray(sc_t.find_var("pw"))
+                             - oracle["pw"]).max()),
+                float(np.abs(np.asarray(sc_t.find_var("pb"))
+                             - oracle["pb"]).max()))
+            st = eng.stats("ptbl")
+        assert st["evictions"] > 0, "parity run never evicted — not tiered"
+        return drift
+    finally:
+        pt_flags.set_flags(saved)
+
+
+def bench_deepfm_giant(on_tpu: bool):
+    """DeepFM with an embedding table provably exceeding the configured HBM
+    budget (ISSUE 10): the minimize()-time rewrite puts fm_emb on the
+    two-tier path — host shards + hot-ID cache — and the feed pipeline
+    resolves misses off the step. Metrics: end-to-end examples/s through
+    train_from_dataset (zipf-skewed ids, the CTR regime the hot-ID cache
+    exists for), cache hit rate / evictions / write-backs, host-tier bytes
+    vs the budget, and the small-scale parameter-parity drift vs the
+    dense-lookup oracle that tools/gate.py hard-fails on."""
+    import os
+    import tempfile
+
+    import paddle_tpu as pt
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu.models import deepfm
+
+    n_fields, n_dense = 26, 13
+    if on_tpu:
+        # fm_emb = 10M x 16 fp32 = 640 MB against a 64 MB budget: the table
+        # provably exceeds the cache tier by 10x
+        vocab, batch, lines_per_file, n_files = 10_000_000, 2048, 16384, 4
+        budget_mb = 64.0
+    else:
+        # CPU: 200k x 16 fp32 = 12.8 MB against a 2 MB budget (6.4x over)
+        vocab, batch, lines_per_file, n_files = 200_000, 256, 1024, 2
+        budget_mb = 2.0
+
+    saved = {k: pt_flags.get_flag(k)
+             for k in ("emb_hbm_budget_mb", "emb_cache_slots")}
+    pt_flags.set_flags({"emb_hbm_budget_mb": budget_mb,
+                        "emb_cache_slots": 0})
+    try:
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup), pt.unique_name.guard():
+            avg_loss, _, feed_names = deepfm.deepfm(
+                n_fields=n_fields, n_dense=n_dense, vocab_size=vocab)
+            pt.optimizer.SGD(learning_rate=1e-3).minimize(avg_loss)
+            block = main_p.global_block
+            use_vars = [block.var(n) for n in feed_names]
+        engine = main_p._tiered_engine
+        assert engine is not None and "fm_emb" in engine.tables, \
+            "fm_emb did not tier — check FLAGS_emb_hbm_budget_mb"
+        ts = engine.tables["fm_emb"]
+
+        rng = np.random.default_rng(0)
+        tmp = tempfile.mkdtemp(prefix="deepfm_giant_")
+        files = []
+        for fi in range(n_files):
+            p = os.path.join(tmp, f"part-{fi}")
+            with open(p, "w") as f:
+                for _ in range(lines_per_file):
+                    # zipf-skewed ids: the production CTR distribution the
+                    # frequency-based hot-ID admission exists for
+                    ids = (rng.zipf(1.5, n_fields) - 1) % vocab
+                    dense = rng.random(n_dense).round(4)
+                    lbl = rng.integers(0, 2)
+                    f.write(f"{n_fields} {' '.join(map(str, ids))} "
+                            f"{n_dense} {' '.join(map(str, dense))} "
+                            f"1 {lbl}\n")
+            files.append(p)
+
+        ds = pt.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(batch)
+        ds.set_thread(4)
+        ds.set_use_var(use_vars)
+        ds.set_filelist(files)
+
+        exe = pt.Executor()
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            drain = main_p.all_parameters()[-1].name
+            exe.train_from_dataset(main_p, ds, print_period=10**9)
+            np.asarray(pt.global_scope().find_var(drain))
+            windows = []
+            for _ in range(5 if on_tpu else 2):
+                t0 = time.perf_counter()
+                exe.train_from_dataset(main_p, ds, print_period=10**9)
+                np.asarray(pt.global_scope().find_var(drain))
+                windows.append(time.perf_counter() - t0)
+            engine.flush_all()
+            stats = engine.stats("fm_emb")
+            (lv,) = exe.run(main_p, feed={
+                "sparse_ids": (rng.zipf(1.5, (batch, n_fields)) - 1)
+                % vocab,
+                "dense_x": rng.random((batch, n_dense)).astype(np.float32),
+                "label": rng.integers(0, 2, (batch, 1)).astype(np.float32),
+            }, fetch_list=[avg_loss])
+            assert np.isfinite(float(np.asarray(lv)))
+
+        dt = min(windows)
+        n_examples = n_files * lines_per_file
+        for p in files:
+            os.unlink(p)
+        os.rmdir(tmp)
+    finally:
+        pt_flags.set_flags(saved)
+
+    parity = _tiered_parity()
+    return {
+        "examples_per_sec": round(n_examples / dt, 2),
+        "windows_ex_s": [round(n_examples / w, 1) for w in windows],
+        "cache_hit_rate": stats["hit_rate"],
+        "evictions": stats.get("evictions", 0),
+        "writebacks": stats.get("writebacks", 0),
+        "cache_slots": stats["slots"],
+        "prefetch_rows": stats["prefetch_rows"],
+        "host_tier_bytes": int(sum(
+            t.host.nbytes for t in engine.tables.values())),
+        "table_bytes": int(ts.host.nbytes),
+        "hbm_budget_mb": budget_mb,
+        "cache_bytes": int((ts.slots + 1) * ts.host.dim
+                           * ts.host.dtype.itemsize),
+        "parity_max_abs_diff": parity,
+        "config": (f"v{vocab // 10**6}M b{batch} f{n_fields} zipf1.5 "
+                   f"budget{budget_mb:g}MB" if on_tpu
+                   else f"v200k b{batch} f{n_fields} zipf1.5 "
+                        f"budget{budget_mb:g}MB"),
+    }
+
+
 def bench_serving(on_tpu: bool):
     """Served-load row (ISSUE 7): synthetic open-loop arrivals against a
     small bert-decoder through the paged-KV continuous-batching engine
@@ -657,6 +859,7 @@ def main():
         tuner_stats, "transformer_wmt", bench_wmt, on_tpu, peak)
     ctr_ex_s, ctr_windows, ctr_dev_ex_s, ctr_guard_pct = _tuned(
         tuner_stats, "deepfm", bench_deepfm, on_tpu)
+    giant = _tuned(tuner_stats, "deepfm_giant", bench_deepfm_giant, on_tpu)
     long_ctx = _tuned(tuner_stats, "bert_s512", bench_bert_long, on_tpu)
     short_ab = _tuned(tuner_stats, "bert_s128_shortattn", bench_bert_short,
                       on_tpu)
@@ -722,6 +925,13 @@ def main():
         # (interleaved ABAB, FLAGS_attention_force_backend arms); gate.py
         # fails if the kernel ENGAGED and lost beyond the band
         "bert_s128_shortattn_ab": short_ab,
+        # ISSUE 10: DeepFM with fm_emb provably over the HBM budget on the
+        # tiered host-shards + hot-ID-cache path (embedding/): end-to-end
+        # examples/s, cache hit rate, host-tier bytes vs budget, and the
+        # small-scale parameter-parity drift vs the dense-lookup oracle.
+        # tools/gate.py hard-fails parity drift > 1e-4; the hit-rate floor
+        # warns on the first artifact and gates thereafter
+        "deepfm_giant": giant,
         # the serving runtime's open-loop load row (serving/): served
         # tokens/s, p50/p99 request + first-token latency, KV-pool
         # occupancy. tools/gate.py fails on leaked KV pages and on a
@@ -742,6 +952,7 @@ def main():
             "wmt": "base b128 s128/128 AMP Adam" if on_tpu else "tiny b8 s16/16",
             "deepfm": ("v100k b2048 f26 d13 QueueDataset" if on_tpu
                        else "v1k b256 f26 d13"),
+            "deepfm_giant": giant["config"],
             "bert_s512": ("base b64 s512 AMP Adam" if on_tpu
                           else "tiny b4 s128"),
         },

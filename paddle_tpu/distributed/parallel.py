@@ -84,7 +84,12 @@ def init_parallel_env(
 
     if backend:
         jax.config.update("jax_platforms", backend)
-        if backend == "cpu":
+        if backend == "cpu" and num_processes > 1:
+            # gloo needs the distributed client wired into backend creation;
+            # jaxlib 0.4.37's make_gloo_tcp_collectives REQUIRES a real
+            # DistributedRuntimeClient (passing None aborts backend init), so
+            # a single-process run must stay on the default implementation —
+            # it has no cross-process collectives to run anyway
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     if num_processes > 1 and not _initialized:

@@ -673,6 +673,15 @@ class Executor:
 
         from .core.selected_rows import is_selected_rows
 
+        # tiered embeddings (embedding/engine.py): feeds staged by the
+        # DeviceLoader arrive pre-resolved carrying a ticket (popped here —
+        # it must not reach the compile signature); raw feeds resolve inline
+        # so the synchronous exe.run path and the parity oracles work too
+        emb_engine = getattr(program, "_tiered_engine", None)
+        emb_ticket = None
+        if emb_engine is not None and feed:
+            feed, emb_ticket = emb_engine.prepare_feed(feed)
+
         block = program.global_block
         feed_names = sorted(feed)
         feed_vals = []
@@ -792,6 +801,11 @@ class Executor:
             scope.set_var(n, v)
         for n, v in zip(comp.extra_w, new_extra):
             scope.set_var(n, v)
+
+        if emb_engine is not None and emb_ticket is not None:
+            # hand the step's evicted-row output handles to the engine (no
+            # sync — write-back lands when the device array materializes)
+            emb_engine.note_dispatched(emb_ticket, scope)
 
         # the in-graph health vector (resilience/guardrails.py) rides the
         # step's outputs: hand the DEVICE array back so reading it after the
@@ -976,18 +990,36 @@ class Executor:
         block = prog.global_block
         multiproc = _spans_processes(mesh)
 
+        emb_engine = getattr(prog, "_tiered_engine", None)
+        if emb_engine is not None:
+            from .embedding.engine import TICKET_KEY
+        else:
+            TICKET_KEY = None
+
         def place(feed: dict) -> dict:
+            if emb_engine is not None and TICKET_KEY not in feed:
+                # the async miss prefetch (ISSUE 10): resolve the batch's
+                # unique-ID set against the host tier ON THIS background
+                # thread, so the derived slot/prefetch feeds stage to the
+                # device with the batch and the compiled step never touches
+                # host memory
+                feed = emb_engine.resolve_feed(feed)
             names = sorted(feed)
             comp = None
             cache = self._cache.get(prog)
             if cache:
+                # compiled entries never see the ticket (popped pre-compile)
+                sig_names = [n for n in names if n != TICKET_KEY]
                 for c in reversed(list(cache.values())):
-                    if list(c.feed_names) == names:
+                    if list(c.feed_names) == sig_names:
                         comp = c
                         break
             out = {}
             for n in names:
                 v = feed[n]
+                if n == TICKET_KEY:
+                    out[n] = v  # host-side ticket: never staged
+                    continue
                 if is_selected_rows(v):
                     out[n] = v
                     continue

@@ -204,6 +204,46 @@ _define("serving_sched_policy", "fcfs",
         "'sjf' (shortest context first — minimizes queue latency under "
         "mixed lengths at the cost of starving long prompts under "
         "sustained load)")
+# tiered giant-embedding knobs (paddle_tpu/embedding/, the minimize()-time
+# rewrite in passes.rewrite_tiered_embeddings — see README "Tiered
+# embeddings")
+_define("emb_hbm_budget_mb", 0.0,
+        "per-table HBM budget in MB for embedding tables: at minimize() "
+        "time every lookup_table whose table exceeds this is rewritten onto "
+        "the two-tier path — host-memory shards behind a device-resident "
+        "hot-ID cache sized to the budget, with miss prefetch resolved off "
+        "the step on the feed pipeline. <=0 (default) disables tiering "
+        "entirely: every table compiles to the existing single-gather path "
+        "bitwise-unchanged")
+_define("emb_cache_slots", 0,
+        "hot-ID cache rows per tiered table; 0 (default) derives the slot "
+        "count from FLAGS_emb_hbm_budget_mb / row bytes through the tuning "
+        "DB ('embedding|table=..' keys — a swept verdict overrides the "
+        "budget-derived prior). A positive value is a hard per-run force "
+        "(A/B arms, tools/tune.py --what embedding)")
+_define("emb_prefetch_rows", 0,
+        "fixed width of the per-step miss-prefetch buffer (the install feed "
+        "is part of the compile signature, so it cannot vary per batch); "
+        "0 = auto — pow2 of the first batch's miss count, growing (one "
+        "recompile) if a later batch overflows. A positive value forces the "
+        "width; batches missing more rows still grow it rather than fail")
+_define("emb_admit_min_freq", 1,
+        "frequency-based cache admission: an id seen fewer than this many "
+        "times total enters the cache on probation (zero accumulated "
+        "frequency, first in line for eviction) instead of with its batch "
+        "count — keeps one-shot ids from displacing hot rows. 1 (default) "
+        "admits every miss at full weight; eviction is min-frequency with "
+        "LRU tie-break either way")
+_define("emb_host_shards", 1,
+        "contiguous row shards per host-tier table (one numpy allocation "
+        "each) — the in-process analogue of the per-pserver row partition, "
+        "and the placement unit for a future multi-host tier")
+_define("emb_ckpt_base_every", 4,
+        "streaming delta checkpoints: a full host-tier base snapshot is "
+        "written every this-many saves (atomically, to the checkpoint "
+        "root); the saves between write only the rows dirtied since the "
+        "base (cumulative delta in the step directory; restore = base + "
+        "that one delta)")
 # distributed liveness knobs (distributed/ps_rpc.py, resilience/watchdog.py)
 _define("rpc_deadline", 180000,
         "pserver RPC deadline in MILLISECONDS (reference FLAGS_rpc_deadline, "

@@ -832,6 +832,68 @@ def lookup_table_grad(ctx: ExecContext):
 register_grad_compute("lookup_table_v2")(lookup_table_grad)
 
 
+def _no_grad_ops_maker(op, block, no_grad_set=frozenset()):
+    """Grad maker for state-plumbing ops that sit ON the gradient path but
+    contribute no gradient ops of their own (the tiered cache install: the
+    cache gradient is produced entirely by tiered_lookup_grad and applied by
+    the optimizer to the post-install value)."""
+    return []
+
+
+@register_op("emb_cache_install", grad=_no_grad_ops_maker)
+def emb_cache_install(ctx: ExecContext):
+    """Land this batch's prefetched host rows in the device cache (tiered
+    embeddings, ISSUE 10). Writes its output back to the SAME cache var name
+    (the executor's rw/donation path — the PR 7 paged-KV pattern), and emits
+    the PRE-install contents of the overwritten slots: those are exactly the
+    evicted rows, carrying every optimizer update they ever received, which
+    the engine writes back to the host tier when the step's output
+    materializes. Padding entries point at the masked scratch slot."""
+    cache, rows, slots = (ctx.input("Cache"), ctx.input("Rows"),
+                          ctx.input("Slots"))
+    slots = slots.astype(np.int32)
+    evicted = jnp.take(cache, slots, axis=0)
+    new_cache = cache.at[slots].set(rows.astype(cache.dtype))
+    return {"Out": new_cache, "Evicted": evicted}
+
+
+@register_op("tiered_lookup")
+def tiered_lookup(ctx: ExecContext):
+    """lookup_table over the hot-ID cache: ids were mapped to cache slots by
+    the host-side resolver (embedding/engine.py), so the compiled step is one
+    HBM gather. Slot `scratch_slot` (the cache's last row) marks padding /
+    unresolvable positions and reads as zeros."""
+    cache, slot_ids = ctx.input("Cache"), ctx.input("SlotIds")
+    idsq = slot_ids.reshape(slot_ids.shape[:-1]) \
+        if slot_ids.shape and slot_ids.shape[-1] == 1 else slot_ids
+    idsq = idsq.astype(np.int32)
+    out = jnp.take(cache, idsq, axis=0)
+    scratch = int(ctx.attr("scratch_slot"))
+    out = jnp.where((idsq == scratch)[..., None], jnp.zeros_like(out), out)
+    return {"Out": out}
+
+
+@register_grad_compute("tiered_lookup")
+def tiered_lookup_grad(ctx: ExecContext):
+    """Cache grad: dense scatter-add over the [slots+1, dim] cache — small by
+    construction (the cache, not the table), so the optimizer's dense row
+    update stays one fused XLA kernel. Scratch-slot positions (padding)
+    contribute nothing, mirroring lookup_table's padding_idx contract."""
+    cache, slot_ids, og = (ctx.input("Cache"), ctx.input("SlotIds"),
+                           ctx.input("Out@GRAD"))
+    if og is None:
+        return {"Cache@GRAD": jnp.zeros_like(cache)}
+    idsq = slot_ids.reshape(slot_ids.shape[:-1]) \
+        if slot_ids.shape and slot_ids.shape[-1] == 1 else slot_ids
+    rows = idsq.reshape(-1).astype(np.int32)
+    width = og.shape[-1]
+    vals = og.reshape(-1, width)
+    scratch = int(ctx.attr("scratch_slot"))
+    vals = jnp.where((rows == scratch)[:, None], jnp.zeros_like(vals), vals)
+    dense = jnp.zeros_like(cache).at[rows].add(vals.astype(cache.dtype))
+    return {"Cache@GRAD": dense}
+
+
 @register_op("accuracy", grad="none")
 def accuracy(ctx: ExecContext):
     idx, label = ctx.input("Indices"), ctx.input("Label")

@@ -77,6 +77,32 @@ class CheckpointManager:
         # post-mortem survives a process restart even if no save follows
         # the event. steps()/latest_step() never see this file.
         self._guard_events: list[dict] = self._load_guard_events()
+        # auxiliary state providers (e.g. the tiered-embedding host tier,
+        # embedding/checkpoint.py): each writes extra files into the atomic
+        # step directory at save and re-reads them at restore, with its
+        # manifest fragment under manifest["extra"][provider.name]
+        self._providers: list = []
+
+    # -- auxiliary state providers -------------------------------------------
+    def register_state_provider(self, provider) -> None:
+        """provider contract: `.name`, `.save_state(manager, tmp_dir, step,
+        executor=, program=, scope=) -> frag`, `.restore_state(manager,
+        step_dir, step, frag, executor=, program=, scope=)`."""
+        self._providers.append(provider)
+
+    def _providers_for(self, program) -> list:
+        """Registered providers, plus auto-discovery: a program carrying a
+        tiered-embedding engine (passes.rewrite_tiered_embeddings) gets its
+        host-tier delta provider without explicit wiring — the runner /
+        train_from_dataset checkpoint paths stay zero-config."""
+        engine = getattr(program, "_tiered_engine", None)
+        if engine is not None and not any(
+                getattr(p, "_engine", None) is engine
+                for p in self._providers):
+            from ..embedding.checkpoint import EmbeddingStateProvider
+
+            self._providers.append(EmbeddingStateProvider(engine))
+        return list(self._providers)
 
     # -- context defaults ----------------------------------------------------
     def _resolve(self, main_program, scope):
@@ -185,6 +211,13 @@ class CheckpointManager:
                             main_program=program, scope=scope)
             if not primary:
                 return final
+            extra = {}
+            for provider in self._providers_for(program):
+                frag = provider.save_state(self, tmp, step,
+                                           executor=executor,
+                                           program=program, scope=scope)
+                if frag is not None:
+                    extra[provider.name] = frag
             manifest = {
                 "format": _FORMAT,
                 "step": step,
@@ -199,6 +232,8 @@ class CheckpointManager:
                     json.dumps(self._guard_events, default=str)),
                 "time": time.time(),
             }
+            if extra:
+                manifest["extra"] = extra
             mpath = os.path.join(tmp, _MANIFEST)
             with open(mpath, "w") as f:
                 json.dump(manifest, f, indent=1)
@@ -284,6 +319,15 @@ class CheckpointManager:
                                 os.path.join(self._step_dir(cand), _STATE),
                                 main_program=program, scope=scope,
                                 shardings=shardings)
+                extra = manifest.get("extra") or {}
+                for provider in self._providers_for(program):
+                    # a provider whose files are gone/corrupt raises here,
+                    # so the candidate quarantines and the next-older one
+                    # is tried — same contract as the state dir itself
+                    provider.restore_state(
+                        self, self._step_dir(cand), cand,
+                        extra.get(provider.name), executor=executor,
+                        program=program, scope=scope)
             except Exception as e:
                 if explicit:
                     raise

@@ -44,6 +44,13 @@ host, reproducibly. This module plants named *sites* in the hot paths —
                       must return to the free list; the chaos test drives
                       repeated abort cycles and asserts the pool leaks
                       zero pages
+    emb_host_stall    the tiered-embedding miss resolver
+                      (embedding/engine.resolve_feed) — the host-tier
+                      prefetch parks forever (a hung remote shard / page-in
+                      storm stand-in) on the DeviceLoader's producer
+                      thread, so the PR 3 consumer-side stall watchdog
+                      must surface it with queue depths instead of the
+                      trainer hanging on an empty staging queue
 
 — and a *plan* that decides, per site and per hit, whether to raise an
 `InjectedFault`. Plans are either explicit hit schedules or seeded Bernoulli
@@ -75,6 +82,7 @@ FAULT_SITES = frozenset({
     "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
     "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
     "collective_stall", "numeric_nan", "numeric_spike", "serving_abort",
+    "emb_host_stall",
 })
 
 

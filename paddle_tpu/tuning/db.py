@@ -34,7 +34,7 @@ DB_SCHEMA = 1
 
 __all__ = ["DB_SCHEMA", "TuningDB", "canonical_key", "conv_key",
            "attention_key", "bucket_key", "amp_key", "collective_key",
-           "epilogue_key", "xent_key"]
+           "epilogue_key", "xent_key", "embedding_key"]
 
 
 def canonical_key(op: str, shape_key: str, dtype: str, device_kind: str) -> str:
@@ -81,6 +81,14 @@ def xent_key(rows: int, vocab: int) -> str:
     """Fused softmax-xent decisions (ops/pallas_kernels/xent.py): the
     kernel's problem is the flattened [rows, vocab] logits tile."""
     return f"rows={rows} v={vocab}"
+
+
+def embedding_key(table: str, vocab: int, dim: int) -> str:
+    """Tiered-embedding cache geometry decisions (embedding/engine.py):
+    keyed on the table's identity and its row geometry — slots and prefetch
+    width trade HBM footprint against hit rate for THIS table's id
+    distribution, so the key must name the table, not just its shape."""
+    return f"table={table} vocab={vocab} dim={dim}"
 
 
 def amp_key(op_type: str) -> str:

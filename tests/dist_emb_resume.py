@@ -1,0 +1,86 @@
+"""Kill-and-resume trainer for the TIERED embedding path (the
+dist_ckpt_resume.py pattern over ISSUE 10's host tier): a 512-row table
+behind a 256-slot cache trains under a CheckpointedRunner whose saves
+stream base + dirty-row deltas through the CheckpointManager manifest.
+With KILL_AT >= 0 the process SIGKILLs itself right after recording that
+step; a fresh invocation restores base + delta, cold-starts the cache, and
+must reproduce the remaining loss trajectory bit for bit.
+
+usage: dist_emb_resume.py CKPT_ROOT LOSSES_FILE TOTAL_STEPS KILL_AT
+"""
+import os
+import signal
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import flags  # noqa: E402
+from paddle_tpu import layers as L  # noqa: E402
+from paddle_tpu.layers import tensor as T  # noqa: E402
+from paddle_tpu.param_attr import ParamAttr  # noqa: E402
+from paddle_tpu.resilience import (CheckpointManager,  # noqa: E402
+                                   CheckpointedRunner)
+
+VOCAB, DIM, FIELDS, BATCH = 512, 8, 6, 32
+
+flags.set_flags({"emb_hbm_budget_mb": 0.001, "emb_cache_slots": 256,
+                 "emb_ckpt_base_every": 3})
+
+
+def build():
+    ids = T.data(name="ids", shape=[FIELDS], dtype="int64")
+    label = T.data(name="label", shape=[1], dtype="float32")
+    emb = L.embedding(ids, size=[VOCAB, DIM], is_sparse=True,
+                      param_attr=ParamAttr(name="tbl"))
+    s = L.reduce_sum(emb, dim=1)
+    logit = L.fc(s, size=1, param_attr=ParamAttr(name="w_out"),
+                 bias_attr=ParamAttr(name="b_out"))
+    return L.mean(L.sigmoid_cross_entropy_with_logits(logit, label))
+
+
+def feed_fn(step):
+    rng = np.random.default_rng(1000 + step)
+    return {"ids": rng.integers(0, VOCAB,
+                                (BATCH, FIELDS)).astype(np.int64),
+            "label": rng.integers(0, 2, (BATCH, 1)).astype(np.float32)}
+
+
+def main():
+    root, losses_path, total_steps, kill_at = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+
+    main_p, startup = pt.Program(), pt.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with pt.program_guard(main_p, startup):
+        with pt.unique_name.guard():
+            loss = build()
+            pt.optimizer.SGD(0.1).minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(startup)
+    runner = CheckpointedRunner(
+        exe, CheckpointManager(root, keep_last_k=3, main_program=main_p),
+        main_program=main_p, save_every=1, max_retries=5)
+
+    f = open(losses_path, "a")
+
+    def on_step(step, outs):
+        f.write(f"{step} {float(np.asarray(outs[0]).reshape(-1)[0]):.17g}\n")
+        f.flush()
+        os.fsync(f.fileno())
+        if step == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    out = runner.run(feed_fn, total_steps, fetch_list=[loss],
+                     on_step=on_step)
+    f.close()
+    print(f"done start={out['start_step']} retries={out['retries']}")
+
+
+if __name__ == "__main__":
+    main()

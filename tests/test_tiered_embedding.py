@@ -1,0 +1,459 @@
+"""Tiered giant-embedding engine (ISSUE 10): the minimize()-time rewrite,
+host-tier/cache parity against the dense-lookup oracle, the async feed-
+pipeline miss prefetch, frequency-based admission/eviction, delta
+checkpoints, the emb_host_stall chaos drill — plus the lookup_table
+padding_idx contract (satellite: forward zeros, no gradient)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags
+from paddle_tpu import layers as L
+from paddle_tpu.layers import tensor as T
+from paddle_tpu.param_attr import ParamAttr
+
+VOCAB, DIM, FIELDS, BATCH = 512, 8, 6, 32
+
+
+@pytest.fixture
+def emb_flags():
+    saved = {k: flags.get_flag(k) for k in (
+        "emb_hbm_budget_mb", "emb_cache_slots", "emb_prefetch_rows",
+        "emb_admit_min_freq", "emb_host_shards", "emb_ckpt_base_every",
+        "device_prefetch_depth", "watchdog_stall_s", "tuning_mode",
+        "tuning_db")}
+    yield flags
+    flags.set_flags(saved)
+
+
+def _build(vocab=VOCAB, dim=DIM, name="tbl"):
+    ids = T.data(name="ids", shape=[FIELDS], dtype="int64")
+    label = T.data(name="label", shape=[1], dtype="float32")
+    emb = L.embedding(ids, size=[vocab, dim], is_sparse=True,
+                      param_attr=ParamAttr(name=name))
+    s = L.reduce_sum(emb, dim=1)
+    logit = L.fc(s, size=1, param_attr=ParamAttr(name="w_out"),
+                 bias_attr=ParamAttr(name="b_out"))
+    loss = L.mean(L.sigmoid_cross_entropy_with_logits(logit, label))
+    return loss
+
+
+def _feed(step, vocab=VOCAB, zipf=False):
+    rng = np.random.default_rng(100 + step)
+    if zipf:
+        ids = (rng.zipf(1.5, (BATCH, FIELDS)) - 1) % vocab
+    else:
+        ids = rng.integers(0, vocab, (BATCH, FIELDS))
+    return {"ids": ids.astype(np.int64),
+            "label": rng.integers(0, 2, (BATCH, 1)).astype(np.float32)}
+
+
+def _minimized(budget_mb, slots=0, seed=7):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    flags.set_flags({"emb_hbm_budget_mb": budget_mb,
+                     "emb_cache_slots": slots})
+    with pt.program_guard(main, startup), pt.unique_name.guard():
+        loss = _build()
+        pt.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+# -- satellite: lookup_table padding_idx contract ----------------------------
+
+def test_lookup_table_padding_idx_forward_zeros_and_no_grad(emb_flags):
+    """padding_idx rows read as zeros AND receive no gradient — the attr is
+    plumbed end-to-end, so a training step must leave the padding row's
+    parameters untouched while real rows move."""
+    pad = 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), pt.unique_name.guard():
+        ids = T.data(name="ids", shape=[4], dtype="int64")
+        emb = L.embedding(ids, size=[16, DIM], padding_idx=pad,
+                          param_attr=ParamAttr(name="ptbl"))
+        loss = L.mean(emb)
+        pt.optimizer.SGD(1.0).minimize(loss)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        w0 = np.array(np.asarray(pt.global_scope().find_var("ptbl")))
+        feed = {"ids": np.array([[pad, 1, 2, pad], [5, pad, 6, 7]],
+                                np.int64)}
+        (out,) = exe.run(main, feed=feed, fetch_list=[emb])
+        out = np.asarray(out)
+        # forward: padding positions are exactly zero even though the row's
+        # parameter values are not
+        assert np.abs(w0[pad]).max() > 0
+        np.testing.assert_array_equal(out[0, 0], np.zeros(DIM))
+        np.testing.assert_array_equal(out[0, 3], np.zeros(DIM))
+        np.testing.assert_array_equal(out[1, 1], np.zeros(DIM))
+        w1 = np.asarray(pt.global_scope().find_var("ptbl"))
+        # backward: the padding row took no gradient; touched rows did
+        np.testing.assert_array_equal(w1[pad], w0[pad])
+        assert np.abs(w1[1] - w0[1]).max() > 0
+        assert np.abs(w1[5] - w0[5]).max() > 0
+        # untouched non-padding rows also unchanged (sanity on the scatter)
+        np.testing.assert_array_equal(w1[9], w0[9])
+
+
+# -- the opt-in-by-budget contract -------------------------------------------
+
+def test_under_budget_table_compiles_bitwise_unchanged(emb_flags):
+    """Acceptance: with tiering enabled but every table under the budget,
+    the built program is IDENTICAL to the tiering-off build — the rewrite
+    is opt-in-by-budget, not a global behavior change."""
+    import json
+
+    progs = {}
+    for label, budget in (("off", 0.0), ("on_big_budget", 1024.0)):
+        main, startup, _ = _minimized(budget)
+        progs[label] = (json.dumps(main.to_dict(), sort_keys=True),
+                        json.dumps(startup.to_dict(), sort_keys=True))
+        assert getattr(main, "_tiered_engine", None) is None
+    assert progs["off"] == progs["on_big_budget"]
+
+
+def test_over_budget_table_rewrites_to_tiered_ops(emb_flags):
+    main, startup, _ = _minimized(0.001, slots=256)
+    ops = [op.type for op in main.global_block.ops]
+    assert "lookup_table" not in ops
+    assert "tiered_lookup" in ops and "emb_cache_install" in ops
+    assert ops.index("emb_cache_install") < ops.index("tiered_lookup")
+    eng = main._tiered_engine
+    assert eng.tables["tbl"].slots == 256
+    # the giant table's device init op is GONE from startup (the host tier
+    # owns those bytes); the cache fill replaced it
+    sops = [(op.type, op.output_names) for op in startup.global_block.ops]
+    assert not any("tbl" in outs and t != "fill_constant"
+                   for t, outs in sops if "tbl@CACHE" not in outs)
+    assert any("tbl@CACHE" in outs for _, outs in sops)
+    # host tier re-drew the SAME distribution the removed init op declared
+    host = eng.tables["tbl"].host
+    assert host.init[0] == "uniform"
+    dense = host.to_dense()
+    assert dense.shape == (VOCAB, DIM)
+    assert np.abs(dense).max() <= host.init[2] + 1e-6
+
+
+# -- parity vs the dense-lookup oracle ---------------------------------------
+
+def test_tiered_training_matches_dense_oracle(emb_flags):
+    """The acceptance oracle: same model, same inits, same batches — N SGD
+    steps through the tiered path (256-slot cache over a 512-row table, so
+    eviction + write-back fire constantly) must land on the dense-lookup
+    run's parameters within 1e-4 (measured: float-associativity only)."""
+    steps = 12
+    main_t, startup_t, loss_t = _minimized(0.001, slots=256)
+    eng = main_t._tiered_engine
+
+    # oracle program + its init values
+    main_o, startup_o, loss_o = _minimized(0.0)
+    exe = pt.Executor()
+    sc_o = pt.Scope()
+    with pt.scope_guard(sc_o):
+        exe.run(startup_o)
+        init = {n: np.array(np.asarray(sc_o.find_var(n)))
+                for n in ("tbl", "w_out", "b_out")}
+
+    import jax
+
+    sc_t = pt.Scope()
+    with pt.scope_guard(sc_t):
+        exe.run(startup_t)
+        eng.tables["tbl"].host.load_rows(np.arange(VOCAB), init["tbl"])
+        eng.tables["tbl"].host.clear_dirty()
+        sc_t.set_var("w_out", jax.device_put(init["w_out"]))
+        sc_t.set_var("b_out", jax.device_put(init["b_out"]))
+        losses_t = []
+        for s in range(steps):
+            (lv,) = exe.run(main_t, feed=_feed(s), fetch_list=[loss_t])
+            losses_t.append(float(np.asarray(lv)))
+        exe.wait()
+        table_t = eng.export_dense("tbl", sc_t)
+        out_t = {n: np.asarray(sc_t.find_var(n))
+                 for n in ("w_out", "b_out")}
+        stats = eng.stats("tbl")
+
+    with pt.scope_guard(sc_o):
+        sc_o.set_var("tbl", jax.device_put(init["tbl"]))
+        losses_o = []
+        for s in range(steps):
+            (lv,) = exe.run(main_o, feed=_feed(s), fetch_list=[loss_o])
+            losses_o.append(float(np.asarray(lv)))
+        table_o = np.asarray(sc_o.find_var("tbl"))
+        out_o = {n: np.asarray(sc_o.find_var(n))
+                 for n in ("w_out", "b_out")}
+
+    np.testing.assert_allclose(losses_t, losses_o, rtol=0, atol=1e-6)
+    assert np.abs(table_t - table_o).max() < 1e-4
+    assert np.abs(out_t["w_out"] - out_o["w_out"]).max() < 1e-4
+    assert np.abs(out_t["b_out"] - out_o["b_out"]).max() < 1e-4
+    # the run genuinely exercised the tiers
+    assert stats["evictions"] > 0 and stats["writebacks"] > 0
+    assert stats["hit_rate"] is not None
+
+
+def test_tiered_async_pipeline_with_device_loader(emb_flags):
+    """The miss prefetch runs OFF the step: feeds flow through the
+    DeviceLoader (background-thread resolution + staging, run_async window)
+    and the trained table still matches the synchronous path exactly."""
+    from paddle_tpu.pipeline import DeviceLoader
+
+    steps = 10
+    flags.set_flags({"device_prefetch_depth": 2})
+    main, startup, loss = _minimized(0.001, slots=256)
+    eng = main._tiered_engine
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()) as sc:
+        exe.run(startup)
+
+        def src():
+            for s in range(steps):
+                yield _feed(s)
+
+        loader = DeviceLoader(lambda: src(), depth=2,
+                              placement=exe.feed_placer(main))
+        for feed in loader:
+            exe.run_async(main, feed=feed, fetch_list=[loss])
+        exe.wait()
+        eng.flush_all()
+        stats = eng.stats("tbl")
+        assert stats["batches"] == steps
+        assert stats["evictions"] > 0
+        # every eviction's write-back landed (none dropped/stuck)
+        assert stats["writebacks"] == stats["evictions"]
+        table_async = eng.export_dense("tbl", sc)
+
+    # synchronous reference over the same batches, same inits (host tier is
+    # re-drawn deterministically from the same seed)
+    main2, startup2, loss2 = _minimized(0.001, slots=256)
+    eng2 = main2._tiered_engine
+    with pt.scope_guard(pt.Scope()) as sc2:
+        exe2 = pt.Executor()
+        exe2.run(startup2)
+        for s in range(steps):
+            exe2.run(main2, feed=_feed(s), fetch_list=[loss2])
+        exe2.wait()
+        table_sync = eng2.export_dense("tbl", sc2)
+    np.testing.assert_allclose(table_async, table_sync, rtol=0, atol=1e-6)
+
+
+# -- admission / eviction policy ---------------------------------------------
+
+def test_frequency_admission_probation_and_lru_fallback(emb_flags):
+    """Under FLAGS_emb_admit_min_freq, a first-seen id enters on probation
+    (zero accumulated frequency) and is evicted before established hot rows;
+    ties break LRU. Driven through the raw engine API."""
+    from paddle_tpu.embedding import HostShardedTable, TieredEmbeddingEngine
+
+    flags.set_flags({"emb_admit_min_freq": 3, "emb_prefetch_rows": 4})
+    host = HostShardedTable("t", 64, 4, init=("uniform", -1, 1), seed=1)
+    eng = TieredEmbeddingEngine()
+    eng.add_table("t", host, slots=4, cache_var="t@CACHE",
+                  rows_var="t@PREFETCH_ROWS", slots_var="t@PREFETCH_SLOTS",
+                  evict_var="t@EVICTED", prefetch_rows=4)
+    eng.add_lookup("t", "ids", "t@SLOTS@ids", None)
+    ts = eng.tables["t"]
+
+    def resolve(ids):
+        feed = eng.resolve_feed({"ids": np.asarray(ids, np.int64)})
+        return feed
+
+    # fill: ids 0,1 seen repeatedly (hot, above threshold), 2,3 once
+    resolve([[0, 1, 0, 1]])
+    resolve([[0, 1, 2, 3]])
+    assert set(ts.row2slot) == {0, 1, 2, 3}
+    # rows 2 and 3 are on probation (seen < 3): a new id must evict one of
+    # THEM (LRU tie-break -> row 2, the older slot), never hot rows 0/1
+    resolve([[4, 4, 4, 4]])
+    assert 0 in ts.row2slot and 1 in ts.row2slot and 4 in ts.row2slot
+    assert 2 not in ts.row2slot
+    # slots referenced by the CURRENT batch are pinned: resolving a batch
+    # that uses 3 and introduces 5 must evict... not 3
+    resolve([[3, 5, 3, 5]])
+    assert 3 in ts.row2slot and 5 in ts.row2slot
+
+
+def test_prefetch_buffer_grows_on_overflow(emb_flags):
+    from paddle_tpu.embedding import HostShardedTable, TieredEmbeddingEngine
+
+    flags.set_flags({"emb_admit_min_freq": 1})
+    host = HostShardedTable("t", 256, 4, init=("constant", 0.5))
+    eng = TieredEmbeddingEngine()
+    eng.add_table("t", host, slots=128, cache_var="c", rows_var="r",
+                  slots_var="s", evict_var="e", prefetch_rows=2)
+    eng.add_lookup("t", "ids", "slots", None)
+    out = eng.resolve_feed({"ids": np.arange(10, dtype=np.int64)[None]})
+    # 10 misses overflow the configured width 2: pow2 growth, padded with
+    # the scratch slot
+    assert out["r"].shape == (16, 4)
+    assert (out["s"][10:] == eng.tables["t"].scratch).all()
+    assert eng.tables["t"].prefetch_rows == 16
+
+
+def test_cache_smaller_than_batch_working_set_raises(emb_flags):
+    main, startup, loss = _minimized(0.001, slots=8)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="working set"):
+            exe.run(main, feed=_feed(0), fetch_list=[loss])
+
+
+# -- tuning integration -------------------------------------------------------
+
+def test_cache_geometry_resolves_through_tuning_db(emb_flags, tmp_path):
+    from paddle_tpu import tuning
+
+    db_path = str(tmp_path / "db.json")
+    key = tuning.canonical_key(
+        "embedding", tuning.embedding_key("tbl", VOCAB, DIM), "float32",
+        tuning.device_kind())
+    db = tuning.TuningDB(db_path)
+    db.put(key, {"slots": 192, "prefetch_rows": 64}, source="swept")
+    db.save(db_path)
+    flags.set_flags({"tuning_mode": "consult", "tuning_db": db_path})
+    tuning.invalidate_db_cache()
+    try:
+        main, _, _ = _minimized(0.001, slots=0)
+        ts = main._tiered_engine.tables["tbl"]
+        assert ts.slots == 192 and ts.prefetch_rows == 64
+    finally:
+        tuning.invalidate_db_cache()
+
+
+def test_sweep_mode_records_embedding_candidate(emb_flags, tmp_path):
+    from paddle_tpu import tuning
+
+    db_path = str(tmp_path / "db.json")
+    flags.set_flags({"tuning_mode": "sweep", "tuning_db": db_path})
+    tuning.invalidate_db_cache()
+    try:
+        _minimized(0.001, slots=0)
+        db = tuning.TuningDB(db_path)
+        keys = [k for k in db.entries if k.startswith("embedding|")]
+        assert keys, sorted(db.entries)
+        assert db.entries[keys[0]]["source"] == "candidate"
+        assert db.entries[keys[0]]["decision"]["slots"] > 0
+    finally:
+        tuning.invalidate_db_cache()
+
+
+# -- chaos: the stalled host tier --------------------------------------------
+
+@pytest.mark.chaos
+def test_emb_host_stall_surfaces_via_watchdog(emb_flags):
+    """A wedged host-tier prefetch (emb_host_stall on the DeviceLoader's
+    producer thread) must raise StallError with queue depths — never hang
+    the trainer on an empty staging queue."""
+    from paddle_tpu.pipeline import DeviceLoader
+    from paddle_tpu.resilience import StallError, fault_scope
+
+    flags.set_flags({"watchdog_stall_s": 0.3})
+    main, startup, loss = _minimized(0.001, slots=256)
+    exe = pt.Executor()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+
+        def src():
+            for s in range(4):
+                yield _feed(s)
+
+        with fault_scope("emb_host_stall:2"):
+            loader = DeviceLoader(lambda: src(), depth=1,
+                                  placement=exe.feed_placer(main))
+            it = iter(loader)
+            exe.run_async(main, feed=next(it), fetch_list=[loss])
+            with pytest.raises(StallError) as exc:
+                for feed in it:
+                    exe.run_async(main, feed=feed, fetch_list=[loss])
+            exe.wait()
+        assert "queue_depth" in exc.value.state
+        assert "DeviceLoader" in exc.value.what
+
+
+# -- streaming delta checkpoints ---------------------------------------------
+
+def test_delta_checkpoint_roundtrip_base_plus_delta(emb_flags, tmp_path):
+    """Host-tier shards checkpoint as base + cumulative dirty-row deltas
+    through the CheckpointManager manifest; restore = base + delta, and the
+    device cache restarts cold with the host tier authoritative."""
+    import glob
+
+    from paddle_tpu.resilience import CheckpointManager
+
+    flags.set_flags({"emb_ckpt_base_every": 2})
+    main, startup, loss = _minimized(0.001, slots=256)
+    eng = main._tiered_engine
+    exe = pt.Executor()
+    root = str(tmp_path / "ck")
+    with pt.scope_guard(pt.Scope()) as sc:
+        exe.run(startup)
+        mgr = CheckpointManager(root, main_program=main, scope=sc)
+        for s in range(4):
+            exe.run(main, feed=_feed(s), fetch_list=[loss])
+            mgr.save(s, executor=exe)
+        snap = eng.export_dense("tbl", sc)
+        # base rotation happened: step 0 base + step 2 base, deltas between
+        bases = sorted(glob.glob(os.path.join(root, "emb_tbl.base_*.npz")))
+        assert len(bases) == 2, bases
+        man = mgr.read_manifest(3)
+        frag = man["extra"]["tiered_embedding"]["tables"]["tbl"]
+        assert frag["base_step"] == 2
+        # poison the host tier + keep training state, then restore: the
+        # table must come back exactly as of the step-3 save
+        eng.tables["tbl"].host.load_rows(
+            np.arange(VOCAB), np.zeros((VOCAB, DIM), np.float32))
+        restored = mgr.restore(executor=exe)
+        assert restored == 3
+        back = eng.tables["tbl"].host.to_dense()
+        np.testing.assert_allclose(back, snap, rtol=0, atol=1e-7)
+        # cache restarted cold
+        assert eng.tables["tbl"].row2slot == {}
+        # and training continues from the restored state
+        (lv,) = exe.run(main, feed=_feed(4), fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv)))
+
+
+def test_delta_checkpoint_kill_and_resume_bit_identical(emb_flags, tmp_path):
+    """SIGKILL a tiered trainer mid-run; a fresh process resumes from
+    base + delta and reproduces the undisturbed loss trajectory bit for
+    bit (the PR 1 contract extended to the host tier)."""
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dist_emb_resume.py")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("FLAGS_fault_plan", None)
+
+    def run(root, losses, kill_at, check=True):
+        p = subprocess.run(
+            [sys.executable, script, root, losses, "10", str(kill_at)],
+            env=env, capture_output=True, timeout=240)
+        if check:
+            assert p.returncode == 0, p.stderr.decode()[-3000:]
+        return p
+
+    def traj(path):
+        out = {}
+        with open(path) as f:
+            for line in f:
+                step, val = line.split()
+                out[int(step)] = val
+        return out
+
+    base = str(tmp_path / "base.txt")
+    run(str(tmp_path / "base_ck"), base, -1)
+    baseline = traj(base)
+    assert sorted(baseline) == list(range(10))
+
+    root, losses = str(tmp_path / "ck"), str(tmp_path / "resumed.txt")
+    p = run(root, losses, 4, check=False)
+    assert p.returncode == -9, (p.returncode, p.stderr.decode()[-2000:])
+    run(root, losses, -1)
+    assert traj(losses) == baseline

@@ -57,6 +57,18 @@ TUNER_HIT_RATE_FLOOR = 0.5
 # a hard fail at any count: the pool never reclaims them.
 SERVING_TOK_S_DROP = 0.8
 
+# tiered embedding engine (ISSUE 10): parameter parity vs the dense-lookup
+# oracle is a hard correctness invariant — the tiered path is a data-movement
+# refactor, any drift beyond float associativity means a lost update
+# (write-back / install / scatter bug), never noise.
+EMB_PARITY_ATOL = 1e-4
+# hit-rate floor for the seeded zipf-1.5 workload: the hot-ID cache exists to
+# keep the skewed head resident, and the workload replays identically every
+# round, so a drop below this is an admission/eviction regression. Warns on
+# the first artifact carrying the block, gates thereafter (the ISSUE 10
+# phase-in rule).
+EMB_HIT_RATE_FLOOR = 0.5
+
 # multichip scaling campaign (ISSUE 8, `gate.py --multichip`). Parity first:
 # every parallel arm must land on the single-device parameter trajectory —
 # drift above this is a wrong collective, not noise (measured drifts sit at
@@ -311,6 +323,52 @@ def _check_serving(data: dict, prev_path: str | None, label: str) -> int:
     return 0
 
 
+def _check_embedding(data: dict, prev_path: str | None, label: str) -> int:
+    """Embedding-cache gate (ISSUE 10): the `deepfm_giant` block's parity
+    drift vs the dense-lookup oracle hard-fails above EMB_PARITY_ATOL; the
+    cache hit-rate floor WARNS when the previous artifact predates the
+    block (first landing) and FAILS once a prior artifact carries it."""
+    blk = data.get("deepfm_giant")
+    if not isinstance(blk, dict):
+        return 0
+    rc = 0
+    parity = blk.get("parity_max_abs_diff")
+    hit = blk.get("cache_hit_rate")
+    print(f"[gate] bench {label}: deepfm_giant {blk.get('examples_per_sec')}"
+          f" ex/s, hit-rate {hit}, parity drift {parity}, host tier "
+          f"{blk.get('host_tier_bytes')} B vs budget "
+          f"{blk.get('hbm_budget_mb')} MB", flush=True)
+    if parity is None or parity > EMB_PARITY_ATOL:
+        print(f"[gate] FAIL: tiered-embedding parameter parity drift "
+              f"{parity} exceeds {EMB_PARITY_ATOL} vs the dense-lookup "
+              f"oracle — an install/write-back/scatter path is losing "
+              f"updates (check evictions vs writebacks in the block before "
+              f"blaming the optimizer)", flush=True)
+        rc = 1
+    if hit is not None and hit < EMB_HIT_RATE_FLOOR:
+        prev_has_block = False
+        if prev_path is not None:
+            try:
+                with open(prev_path) as f:
+                    prev = _bench_metrics(f.read())
+                prev_has_block = isinstance((prev or {}).get("deepfm_giant"),
+                                            dict)
+            except (OSError, ValueError):
+                pass
+        if prev_has_block:
+            print(f"[gate] FAIL: deepfm_giant cache hit-rate {hit} fell "
+                  f"below {EMB_HIT_RATE_FLOOR} on the seeded zipf workload "
+                  f"— the admission/eviction policy regressed (the id "
+                  f"stream is identical every round)", flush=True)
+            rc = 1
+        else:
+            print(f"[gate] WARN: deepfm_giant cache hit-rate {hit} < "
+                  f"{EMB_HIT_RATE_FLOOR} on the block's first artifact — "
+                  f"recorded as the baseline; this gates from the next "
+                  f"round", flush=True)
+    return rc
+
+
 def check_multichip(path: str | None = None) -> int:
     """`--multichip`: gate the newest MULTICHIP_r*.json campaign artifact
     (ISSUE 8) the way check_bench gates BENCH — loss/parameter parity drift
@@ -423,6 +481,8 @@ def check_bench(path: str | None = None) -> int:
     if _check_tuner_coverage(data, os.path.basename(path)):
         return 1
     if _check_serving(data, prev_path, os.path.basename(path)):
+        return 1
+    if _check_embedding(data, prev_path, os.path.basename(path)):
         return 1
     ratio = data.get("deepfm_e2e_device_ratio")
     if ratio is None:

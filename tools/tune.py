@@ -23,9 +23,16 @@ Sweeps:
                PERF.md r6 cost-table conv OUTPUT shapes (the BN apply tail,
                NHWC + NCHW, with and without residual) and the bench BERT
                s128 layer-norm rows.
-  candidates — every `candidate` conv2d / attention / epilogue entry a
-               FLAGS_tuning_mode=sweep run recorded into the DB gets
-               measured and upgraded.
+  embedding  — tiered-embedding cache geometry (ISSUE 10): slot-count and
+               prefetch-width arms per table geometry, each arm a real
+               one-table training loop (resolve + install + gather +
+               scatter-add through the Executor — the resolution cost IS
+               part of what the geometry trades), driven by a seeded zipf
+               id stream. Verdicts land as 'embedding|table=..' keys the
+               minimize()-time rewrite consults.
+  candidates — every `candidate` conv2d / attention / epilogue / embedding
+               entry a FLAGS_tuning_mode=sweep run recorded into the DB
+               gets measured and upgraded.
 
 These are per-shape microbenches — TVM-style schedule search, deliberately
 NOT the chained-per-op instrument PERF.md retired (each arm here is one
@@ -101,6 +108,17 @@ EPILOGUE_BN_SHAPES = [
 
 EPILOGUE_LN_SHAPES = [
     ("bert_s128_ln", 128 * 128, 768),
+]
+
+
+# the embedding sweep's table geometries (name, vocab, dim, ids_per_batch):
+# a CTR-scale narrow table, a wide ranker table, and a mid shape — the three
+# regimes the slots-vs-hit-rate trade actually differs across. ids_per_batch
+# is the per-step lookup volume (batch x fields).
+EMBEDDING_GEOMETRIES = [
+    ("ctr_v200k_d16", 200_000, 16, 2048),
+    ("ctr_v50k_d32", 50_000, 32, 1024),
+    ("ranker_v100k_d64", 100_000, 64, 512),
 ]
 
 
@@ -410,6 +428,146 @@ def _sweep_epilogue_jobs(db, jobs, dtype: str, iters: int, passes: int,
                           "verdict": verdict}), flush=True)
 
 
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _emb_arm_ex_s(vocab: int, dim: int, ids_per_batch: int, slots: int,
+                  prefetch: int, steps_per_window: int, passes: int):
+    """Time one cache-geometry arm end-to-end: a fresh one-table program
+    (sum-pooled embedding -> sigmoid loss -> SGD) trained over a seeded
+    zipf id stream through the REAL tiered stack — minimize()-time rewrite,
+    host-side resolve, install/gather/scatter step. Returns (measure dict
+    with per-step seconds, stats dict). Resolution runs inline (sync) so
+    the measured cost includes the host work the geometry must amortize."""
+    import paddle_tpu as pt
+    from paddle_tpu import flags as ptf
+    from paddle_tpu import layers as L
+    from paddle_tpu.layers import tensor as T
+    from paddle_tpu.param_attr import ParamAttr
+
+    batch = max(1, min(128, ids_per_batch))
+    fields = max(1, ids_per_batch // batch)
+    rng = np.random.default_rng(7)
+    feeds = []
+    for _ in range(8):
+        ids = (rng.zipf(1.5, (batch, fields)) - 1) % vocab
+        feeds.append({
+            "ids": ids.astype(np.int32),
+            "label": rng.integers(0, 2, (batch, 1)).astype(np.float32)})
+
+    saved = {k: ptf.get_flag(k) for k in (
+        "emb_hbm_budget_mb", "emb_cache_slots", "emb_prefetch_rows")}
+    ptf.set_flags({"emb_hbm_budget_mb": 1e-6, "emb_cache_slots": int(slots),
+                   "emb_prefetch_rows": int(prefetch)})
+    try:
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 7
+        with pt.program_guard(main, startup), pt.unique_name.guard():
+            ids_v = T.data(name="ids", shape=[fields], dtype="int64")
+            label = T.data(name="label", shape=[1], dtype="float32")
+            emb = L.embedding(ids_v, size=[vocab, dim],
+                              param_attr=ParamAttr(name="sweep_tbl"))
+            pooled = L.reduce_sum(emb, dim=1)
+            logit = L.fc(pooled, size=1)
+            loss = L.mean(
+                L.sigmoid_cross_entropy_with_logits(logit, label))
+            pt.optimizer.SGD(0.1).minimize(loss)
+        eng = main._tiered_engine
+        assert eng is not None and "sweep_tbl" in eng.tables, \
+            "sweep arm did not tier — budget/geometry wiring broke"
+        exe = pt.Executor()
+        step = [0]
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            cache_name = eng.tables["sweep_tbl"].cache_var
+
+            def run_once():
+                exe.run_async(main, feed=feeds[step[0] % len(feeds)])
+                step[0] += 1
+
+            def drain():
+                exe.wait()
+                return pt.global_scope().find_var(cache_name)
+
+            m = _timing.measure(run_once, drain, steps_per_window, passes)
+            eng.flush_all()
+            stats = eng.stats("sweep_tbl")
+        return m, stats
+    finally:
+        ptf.set_flags(saved)
+
+
+def sweep_embedding(db, geometries, dtype: str, iters: int, passes: int,
+                    band: float, table_names: dict | None = None):
+    """Cache-geometry sweep (ISSUE 10): per table geometry, slot-count arms
+    around the working set (the budget-derived prior is the base) then
+    prefetch-width arms on the winning slot count. The swept verdict is the
+    decision the minimize()-time rewrite consults for that table key;
+    ties keep the analytic call per the r5 rule. `table_names` maps a
+    geometry name to the REAL table name to record under (candidate
+    upgrades); default records under the geometry name."""
+    from paddle_tpu import tuning as _t
+
+    key_dtype = str(jnp.dtype(dtype))
+    for name, vocab, dim, ids_per_batch in geometries:
+        # working-set estimate: unique ids of one zipf batch
+        rng = np.random.default_rng(7)
+        uniq = len(np.unique((rng.zipf(1.5, ids_per_batch) - 1) % vocab))
+        base_slots = min(_pow2(max(4 * uniq, 2)), max(2, vocab))
+        arm_slots = sorted({min(_pow2(max(2 * uniq, 2)), max(2, vocab)),
+                            base_slots,
+                            min(_pow2(max(8 * uniq, 2)), max(2, vocab))})
+        print(json.dumps({"sweep": "embedding", "shape": name,
+                          "uniq_per_batch": uniq,
+                          "arms": [f"slots{s}" for s in arm_slots]}),
+              flush=True)
+        measured, stats_by = {}, {}
+        for s in arm_slots:
+            m, st = _emb_arm_ex_s(vocab, dim, ids_per_batch, s, 0,
+                                  iters, passes)
+            m["hit_rate"] = st.get("hit_rate")
+            measured[f"slots{s}"] = m
+            print(json.dumps({"arm": f"slots{s}", **m}), flush=True)
+            stats_by[f"slots{s}"] = st
+        winner, verdict = _verdict_vs_base(measured, f"slots{base_slots}",
+                                           band)
+        best_slots = int(winner[len("slots"):])
+        # prefetch-width mini-sweep on the winning slot count: auto (pow2 of
+        # the first batch's miss count) vs double that, which trades padded
+        # transfer bytes against overflow recompiles
+        auto_pf = int(stats_by[winner].get("prefetch_rows") or 0)
+        pf_measured = {f"pf{auto_pf}": measured[winner]}
+        best_pf = auto_pf
+        if auto_pf:
+            m2, _ = _emb_arm_ex_s(vocab, dim, ids_per_batch, best_slots,
+                                  2 * auto_pf, iters, passes)
+            pf_measured[f"pf{2 * auto_pf}"] = m2
+            print(json.dumps({"arm": f"pf{2 * auto_pf}", **m2}), flush=True)
+            pw, pv = _verdict_vs_base(pf_measured, f"pf{auto_pf}", band)
+            if pv == "keep":
+                best_pf = int(pw[len("pf"):])
+        table = (table_names or {}).get(name, name)
+        key = _t.canonical_key(
+            "embedding", _t.embedding_key(table, vocab, dim), key_dtype,
+            _t.device_kind())
+        decision = {"slots": best_slots, "prefetch_rows": best_pf}
+        db.put(key, decision, source="swept",
+               measured={a: {"median_s": m["median_s"], "band": m["band"],
+                             "hit_rate": m.get("hit_rate")}
+                         for a, m in {**measured, **pf_measured}.items()},
+               note=f"{name}: verdict={verdict} base=slots{base_slots}")
+        print(json.dumps({"shape": name, "decision": decision,
+                          "verdict": verdict}), flush=True)
+
+
+_EMB_KEY_RE = re.compile(
+    r"^embedding\|table=(\S+) vocab=(\d+) dim=(\d+)\|([\w.]+)\|")
+
+
 _CONV_KEY_RE = re.compile(
     r"^conv2d\|n=(\d+) out=(\d+)x(\d+) cin=(\d+) cout=(\d+) k=(\d+)x(\d+) "
     r"s=(\d+)x(\d+) d=(\d+)x(\d+) (NHWC|NCHW)\|([\w.]+)\|")
@@ -437,8 +595,22 @@ def sweep_candidates(db, iters, passes, band):
     attn_groups: dict[str, list] = {}
     decode_groups: dict[str, list] = {}
     epi_groups: dict[str, tuple[list, list]] = {}
+    emb_groups: dict[str, tuple[list, dict]] = {}
     for ckey, entry in sorted(db.entries.items()):
         if entry.get("source") != "candidate":
+            continue
+        gm = _EMB_KEY_RE.match(ckey)
+        if gm:
+            table, vocab, dim = gm.group(1), int(gm.group(2)), \
+                int(gm.group(3))
+            dt = gm.group(4)
+            geoms, names = emb_groups.setdefault(dt, ([], {}))
+            # probe the geometry with a representative per-batch lookup
+            # volume — the runtime candidate records table identity + shape,
+            # not the workload's batch, so the sweep supplies the load
+            gname = f"candidate_{table}"
+            geoms.append((gname, vocab, dim, min(2048, max(64, vocab // 8))))
+            names[gname] = table
             continue
         am = _ATTN_KEY_RE.match(ckey)
         if am:
@@ -467,6 +639,9 @@ def sweep_candidates(db, iters, passes, band):
                 bn_s.append((f"candidate_bn_{rows}x{c}", kind, rows, c,
                              cpos, act, bool(has_res)))
             continue
+    for dt, (geoms, names) in sorted(emb_groups.items()):
+        sweep_embedding(db, geoms, dt, iters, passes, band,
+                        table_names=names)
     for dt, shapes in sorted(attn_groups.items()):
         sweep_attention(db, shapes, dt, iters, passes, band)
     for dt, shapes in sorted(decode_groups.items()):
@@ -513,7 +688,8 @@ def main():
     ap.add_argument("--db", default=os.environ.get("FLAGS_tuning_db",
                                                    "TUNING_DB.json"))
     ap.add_argument("--what", default="conv,attention,epilogue",
-                    help="comma list: conv, attention, epilogue, candidates")
+                    help="comma list: conv, attention, epilogue, embedding, "
+                         "candidates")
     on_tpu = jax.devices()[0].platform == "tpu"
     ap.add_argument("--iters", type=int, default=20 if on_tpu else 3)
     ap.add_argument("--passes", type=int, default=3 if on_tpu else 2)
@@ -528,6 +704,10 @@ def main():
     decode_shapes = DECODE_ATTENTION_SHAPES
     epi_bn_shapes = EPILOGUE_BN_SHAPES
     epi_ln_shapes = EPILOGUE_LN_SHAPES
+    emb_geometries = EMBEDDING_GEOMETRIES
+    if args.small or not on_tpu:
+        emb_geometries = [(nm, v // 8, d, max(64, b // 8))
+                          for nm, v, d, b in EMBEDDING_GEOMETRIES]
     if args.small or not on_tpu:
         conv_shapes = [(nm, 8, h // 4, w // 4, ci, co, kh, kw, st, pd, d)
                        for nm, _, h, w, ci, co, kh, kw, st, pd, d
@@ -556,6 +736,9 @@ def main():
     if "epilogue" in what:
         sweep_epilogue(db, epi_bn_shapes, epi_ln_shapes, args.dtype,
                        args.iters, args.passes, args.band)
+    if "embedding" in what:
+        sweep_embedding(db, emb_geometries, args.dtype, args.iters,
+                        args.passes, args.band)
     if "candidates" in what:
         sweep_candidates(db, args.iters, args.passes, args.band)
     db.save(args.db)
