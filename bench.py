@@ -786,7 +786,58 @@ def bench_serving(on_tpu: bool):
     out = _serve_ab.run_open_loop(engine, wl)
     out["config"] = ("dec6x512 b16 pool2048x16 open-loop r32" if on_tpu
                      else "tiny pool64x4 open-loop r16")
+    out["shared_prefix"] = _bench_shared_prefix(on_tpu)
     return out
+
+
+def _bench_shared_prefix(on_tpu: bool):
+    """The ISSUE 11 multi-tenant A/B: a zipf shared-system-prompt mix at
+    10x the r8 request rate through three arms over the SAME seeded trace —
+    the PR 7 baseline (no cache, no speculation), copy-on-write prefix
+    caching, and prefix caching + speculative decoding (draft k=4, exact
+    under greedy). Steady-state, compile-free measurement
+    (tools/_serve_ab.run_open_loop warmup protocol). tools/gate.py
+    hard-fails page/refcount leaks in ANY arm and a prefix-cache hit rate
+    below floor."""
+    from paddle_tpu.serving import ServingEngine
+    from tools import _serve_ab
+
+    cfg, _, user_lens = _serve_ab.ab_config(on_tpu, shared_prefix=True)
+    import paddle_tpu as pt
+
+    ps = int(pt.flags.get_flag("serving_page_size"))
+    if on_tpu:
+        n_req, max_new, rate, sys_len = 64, 16, 640.0, 8 * ps
+    else:
+        n_req, max_new, rate, sys_len = 32, 4, 320.0, 6 * ps
+    wl = _serve_ab.synth_shared_prefix_workload(
+        n_req, cfg.vocab_size, seed=0, n_sys_prompts=8, sys_len=sys_len,
+        user_lens=user_lens, max_new=max_new, rate=rate)
+    arms = {}
+    for name, prefix, draft in (("baseline", False, 0),
+                                ("prefix", True, 0),
+                                ("prefix_spec", True, 4)):
+        eng = ServingEngine(cfg, prefix_cache=prefix, draft_k=draft)
+        r = _serve_ab.run_open_loop(eng, wl, warmup=True)
+        arms[name] = {k: r[k] for k in (
+            "served_tokens_per_sec", "prefill_tokens_computed",
+            "prefix_cache_hit_rate", "spec_accept_rate",
+            "tokens_per_decode_step", "kv_pages_leaked", "refcount_leaks",
+            "cow_copies")}
+        arms[name]["request_latency_p50_ms"] = r["request_latency"].get(
+            "p50_ms")
+    base = arms["baseline"]["served_tokens_per_sec"]
+    return {
+        "arms": arms,
+        "rate_req_s": rate,
+        "vs_baseline_tok_s": round(
+            arms["prefix"]["served_tokens_per_sec"] / max(base, 1e-9), 3),
+        "prefill_tokens_saved": (
+            arms["baseline"]["prefill_tokens_computed"]
+            - arms["prefix"]["prefill_tokens_computed"]),
+        "config": (f"shared-prefix zipf1.2 sys{sys_len} r{rate:g} "
+                   f"n{n_req}"),
+    }
 
 
 def _tuned(tuner_stats: dict, name: str, fn, *args):

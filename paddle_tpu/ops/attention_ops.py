@@ -206,7 +206,7 @@ def _pallas_paged_ok(q_shape, pool_shape) -> bool:
 
 
 def paged_attention_backend(batch, num_heads, kv_slots, head_dim, dtype,
-                            pool_shape=None):
+                            pool_shape=None, tp=1):
     """Which kernel carries one ragged decode-attention shape (sq=1, sk =
     the padded slot count P*page_size). Returns (backend, tier) with backend
     in {"xla", "pallas_paged"}.
@@ -217,7 +217,12 @@ def paged_attention_backend(batch, num_heads, kv_slots, head_dim, dtype,
     a swept DB entry for the exact (b, nh, 1, sk, dh) key overrides it —
     tools/tune.py's decode sweep writes those — and a swept backend the
     current build cannot execute degrades at dispatch, never obeyed blindly.
+
+    tp > 1 (ISSUE 11): the op traces at the GLOBAL shape but under GSPMD
+    each tp shard executes nh/tp heads, so the DB key is the PER-SHARD
+    shape — exactly what tools/tune.py's head-sharded decode sweep records.
     """
+    num_heads = max(1, int(num_heads) // max(1, int(tp)))
     def analytic():
         if pool_shape is not None and _pallas_paged_ok(
                 (batch, num_heads, head_dim), pool_shape):
@@ -265,7 +270,7 @@ def _paged_attention_reference(q, k_pool, v_pool, page_table, kv_lens,
 
 
 def paged_decode_attention_fn(q, k_pool, v_pool, page_table, kv_lens,
-                              sm_scale=1.0):
+                              sm_scale=1.0, tp=1):
     """Dispatch per `paged_attention_backend`: the Pallas page-DMA kernel
     where it can run (and the tuner has not retired it for this shape), the
     XLA gather reference everywhere else — including when a swept-DB verdict
@@ -273,7 +278,7 @@ def paged_decode_attention_fn(q, k_pool, v_pool, page_table, kv_lens,
     B, nh, dh = q.shape
     P, ps = page_table.shape[1], k_pool.shape[1]
     backend, _tier = paged_attention_backend(B, nh, P * ps, dh, q.dtype,
-                                             pool_shape=k_pool.shape)
+                                             pool_shape=k_pool.shape, tp=tp)
     if backend == "pallas_paged" and _pallas_paged_ok(q.shape, k_pool.shape):
         from .pallas_kernels import paged_attention as ppa
 
@@ -313,22 +318,37 @@ def kv_cache_append_fn(k_pool, v_pool, k, v, page_table, positions,
     return k_pool, v_pool
 
 
-def kv_cache_prefill_write_fn(k_pool, v_pool, k, v, page_table, lens):
-    """Write a prefill's whole-context K/V into the paged pool.
+def kv_cache_prefill_write_fn(k_pool, v_pool, k, v, page_table, lens,
+                              start=None):
+    """Write a prefill window's K/V into the paged pool.
 
     k/v: [B, nh, S, dh] (the prefill attention's per-layer projections, in
-    head-major layout as the encoder produces them); lens: [B] int32 actual
-    prompt lengths — positions s >= lens[b] (bucket padding) are dropped.
+    head-major layout as the encoder produces them).
+
+    Without `start` (the PR 7 whole-prompt prefill): local index s writes
+    slot s; lens [B] are actual prompt lengths, positions s >= lens[b]
+    (bucket padding) are dropped.
+
+    With `start` [B] int32 (ISSUE 11 — suffix prefill past a cached prefix,
+    and the speculative-decode verify window): local index s writes slot
+    start[b] + s, and lens[b] counts the VALID LOCAL positions, so only
+    s < lens[b] writes. Rows the scheduler padded pass lens 0 and write
+    nothing — the batch_mask convention without needing a second feed.
     """
     B, nh, S, dh = k.shape
     ps = k_pool.shape[1]
     P = page_table.shape[1]
     pos = jnp.arange(S, dtype=jnp.int32)
+    if start is None:
+        gpos = jnp.broadcast_to(pos[None, :], (B, S))     # [B, S]
+        valid = pos[None, :] < lens[:, None]
+    else:
+        gpos = jnp.reshape(start, (-1,))[:, None] + pos[None, :]
+        valid = pos[None, :] < lens[:, None]
     page_idx = jnp.take_along_axis(
-        page_table, jnp.clip(pos // ps, 0, P - 1)[None, :].repeat(B, 0),
-        axis=1)                                           # [B, S]
-    page_idx = jnp.where(pos[None, :] < lens[:, None], page_idx, _DROP_PAGE)
-    slot = jnp.broadcast_to(pos % ps, (B, S))
+        page_table, jnp.clip(gpos // ps, 0, P - 1), axis=1)  # [B, S]
+    page_idx = jnp.where(valid, page_idx, _DROP_PAGE)
+    slot = gpos % ps
     k_bs = jnp.transpose(k, (0, 2, 1, 3))                 # [B, S, nh, dh]
     v_bs = jnp.transpose(v, (0, 2, 1, 3))
     k_pool = k_pool.at[page_idx, slot].set(k_bs.astype(k_pool.dtype),
@@ -336,6 +356,37 @@ def kv_cache_prefill_write_fn(k_pool, v_pool, k, v, page_table, lens):
     v_pool = v_pool.at[page_idx, slot].set(v_bs.astype(v_pool.dtype),
                                            mode="drop")
     return k_pool, v_pool
+
+
+def paged_prefill_attention_fn(q, k_pool, v_pool, page_table, start,
+                               sm_scale=1.0):
+    """Windowed causal attention OVER THE POOL: query s of row b (global
+    position start[b] + s) attends pool slots 0..start[b]+s inclusive.
+
+    The one attention primitive both new multi-tenant stages need
+    (arXiv:2104.05755's reusable-primitive argument): suffix prefill past a
+    shared prefix (the suffix's K/V is appended to the pool first, so the
+    whole context — cached prefix + fresh suffix — is read from one place),
+    and the speculative-decode verify window (k+1 queries per row in one
+    step). XLA gather reference; fp32 softmax statistics; garbage slots
+    past the window are masked with the framework-wide -1e9 convention.
+    q: [B, nh, S, dh] -> out [B, nh, S, dh].
+    """
+    B, nh, S, dh = q.shape
+    num_pages, ps = k_pool.shape[0], k_pool.shape[1]
+    P = page_table.shape[1]
+    pt = jnp.clip(page_table, 0, num_pages - 1)
+    k = k_pool[pt].reshape(B, P * ps, nh, dh)
+    v = v_pool[pt].reshape(B, P * ps, nh, dh)
+    s = jnp.einsum("bhsd,bkhd->bhsk", q, k) * sm_scale
+    s = s.astype(jnp.float32)
+    slot = jnp.arange(P * ps, dtype=jnp.int32)
+    limit = (jnp.reshape(start, (-1,))[:, None]
+             + jnp.arange(S, dtype=jnp.int32)[None, :])   # [B, S]
+    mask = slot[None, None, None, :] <= limit[:, None, :, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhsk,bkhd->bhsd", probs.astype(q.dtype), v)
 
 
 @register_op("paged_decode_attention", grad="none")
@@ -349,7 +400,8 @@ def paged_decode_attention_op(ctx: ExecContext):
     out = paged_decode_attention_fn(
         q, kp, vp, ctx.input("PageTable"),
         ctx.input("Positions").astype(jnp.int32) + 1,
-        sm_scale=ctx.attr("sm_scale", 1.0))
+        sm_scale=ctx.attr("sm_scale", 1.0),
+        tp=ctx.attr("tp_degree", 1))
     return {"Out": out.astype(q.dtype)}
 
 
@@ -370,12 +422,45 @@ def kv_cache_append_op(ctx: ExecContext):
 
 @register_op("kv_cache_prefill_write", grad="none")
 def kv_cache_prefill_write_op(ctx: ExecContext):
-    """inputs: KPool/VPool, K/V [B, nh, S, dh], PageTable [B, P], Lens [B].
-    Same in-place output aliasing contract as kv_cache_append."""
+    """inputs: KPool/VPool, K/V [B, nh, S, dh], PageTable [B, P], Lens [B],
+    optional Start [B] (windowed write at slots Start+s, Lens counts local
+    valid positions — the suffix-prefill/verify regime). Same in-place
+    output aliasing contract as kv_cache_append."""
+    start = (ctx.input("Start").astype(jnp.int32)
+             if ctx.has_input("Start") else None)
     kp, vp = kv_cache_prefill_write_fn(
         ctx.input("KPool"), ctx.input("VPool"), ctx.input("K"),
         ctx.input("V"), ctx.input("PageTable"),
-        ctx.input("Lens").astype(jnp.int32))
+        ctx.input("Lens").astype(jnp.int32), start)
+    return {"KPoolOut": kp, "VPoolOut": vp}
+
+
+@register_op("paged_prefill_attention", grad="none")
+def paged_prefill_attention_op(ctx: ExecContext):
+    """inputs: Q [B, nh, S, dh], KPool/VPool, PageTable [B, P], Start [B]
+    int32 (query s's global position is Start+s; it attends pool slots
+    0..Start+s inclusive — its own just-written KV included); attrs:
+    sm_scale. Output: [B, nh, S, dh]."""
+    q = ctx.input("Q")
+    out = paged_prefill_attention_fn(
+        q, ctx.input("KPool"), ctx.input("VPool"), ctx.input("PageTable"),
+        ctx.input("Start").astype(jnp.int32),
+        sm_scale=ctx.attr("sm_scale", 1.0))
+    return {"Out": out.astype(q.dtype)}
+
+
+@register_op("kv_cache_copy_page", grad="none")
+def kv_cache_copy_page_op(ctx: ExecContext):
+    """Copy-on-write's copy: inputs KPool/VPool, Src [1] int32, Dst [1]
+    int32 — pool[Dst] := pool[Src] for K and V, in place via the same
+    output-aliasing donation contract as the other cache ops. The engine
+    runs this once per COW'd page BEFORE the write that would have landed
+    on a shared page."""
+    kp, vp = ctx.input("KPool"), ctx.input("VPool")
+    src = ctx.input("Src").astype(jnp.int32)[0]
+    dst = ctx.input("Dst").astype(jnp.int32)[0]
+    kp = kp.at[dst].set(kp[src])
+    vp = vp.at[dst].set(vp[src])
     return {"KPoolOut": kp, "VPoolOut": vp}
 
 

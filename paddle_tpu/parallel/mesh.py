@@ -13,7 +13,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "axes_desc", "CommContext", "get_comm_context", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
+__all__ = ["make_mesh", "make_tp_mesh", "axes_desc", "CommContext", "get_comm_context", "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
 
 DATA_AXIS = "dp"
 MODEL_AXIS = "tp"
@@ -44,6 +44,22 @@ def make_mesh(shape: dict | None = None, places=None, devices=None) -> Mesh:
         sizes[sizes.index(-1)] = n // known
     arr = np.array(devs[: int(np.prod(sizes))]).reshape(sizes)
     return Mesh(arr, tuple(names))
+
+
+def make_tp_mesh(tp: int, devices=None) -> Mesh:
+    """A pure tensor-parallel mesh (the serving engine's sharded-decode
+    regime, ISSUE 11): `tp` devices on the MODEL_AXIS and nothing else —
+    feeds replicate (no dp axis to shard batches over) while head-sharded
+    params/KV pools split per their annotations."""
+    devs = list(devices if devices is not None else jax.devices())
+    if int(tp) < 1:
+        raise ValueError(f"tp degree must be >= 1, got {tp}")
+    if len(devs) < int(tp):
+        raise ValueError(
+            f"tp degree {tp} exceeds the {len(devs)} visible devices "
+            f"(off-TPU tests provision 8 via "
+            f"--xla_force_host_platform_device_count)")
+    return Mesh(np.array(devs[:int(tp)]), (MODEL_AXIS,))
 
 
 def axes_desc(mesh_or_nranks) -> str:

@@ -3,32 +3,48 @@ paged decode attention (ROADMAP item 1; "Ragged Paged Attention",
 arXiv:2604.15464 for the kernel, "Tensor Processing Primitives",
 arXiv:2104.05755 for the reusable-primitive framing).
 
-Three pieces, one runtime:
+Four pieces, one runtime:
   * `kv_cache`   — fixed-size pages over a preallocated HBM pool (device
                    side: persistable pool vars the compiled steps update in
-                   place; host side: free-list + per-request page tables);
+                   place; host side: refcounted free-list + per-request page
+                   tables + the page-granular PrefixCache that lets requests
+                   sharing a system prompt map the SAME physical pages);
   * `model`      — the served decoder expressed as bucketed prefill /
-                   ragged decode programs over one explicit weight
-                   namespace (plus the dense oracle for equivalence tests);
+                   windowed suffix-prefill+verify / ragged decode programs
+                   over one explicit weight namespace (plus the dense
+                   oracle for equivalence tests, the COW page-copy step,
+                   and the GSPMD tp annotations);
   * `engine`     — the continuous-batching scheduler: admit/evict between
-                   decode steps, backpressure on pool exhaustion,
-                   recompute-style preemption, chaos-abort page reclamation.
+                   decode steps, copy-on-write prefix reuse, speculative
+                   draft-verify decode (exact under greedy), backpressure
+                   on pool exhaustion, recompute-style preemption,
+                   chaos-abort page reclamation with refcount accounting;
+  * `sampling`   — per-request temperature/top-k/top-p with per-(seed,
+                   request, token) determinism across batch-bucket
+                   recompiles.
 
 Knobs: FLAGS_serving_page_size, FLAGS_serving_pool_pages,
-FLAGS_serving_max_inflight, FLAGS_serving_sched_policy (see README
-"Serving"). Load: tools/_serve_ab.py (open-loop arrival sweep) and the
-bench.py `serving` block (served tokens/s, p50/p99 latency, pool occupancy)
-gated by tools/gate.py.
+FLAGS_serving_max_inflight, FLAGS_serving_sched_policy,
+FLAGS_serving_prefix_cache, FLAGS_serving_draft_k, FLAGS_serving_tp (see
+README "Serving"). Load: tools/_serve_ab.py (open-loop arrival sweep incl.
+the --shared-prefix zipf mix + --ab baseline arm) and the bench.py
+`serving` block (served tokens/s, p50/p99 latency, pool occupancy, the
+three-arm shared_prefix A/B) gated by tools/gate.py.
 """
-from .engine import ContinuousBatchingScheduler, GenRequest, ServingEngine
-from .kv_cache import PagedKVPool, create_device_pools, pool_var_names
+from .engine import (ContinuousBatchingScheduler, GenRequest, ServingEngine,
+                     ngram_draft)
+from .kv_cache import (PagedKVPool, PrefixCache, create_device_pools,
+                       pool_var_names)
 from .model import (DecoderConfig, build_decode_program,
                     build_full_forward_program, build_prefill_program,
-                    decoder_tiny)
+                    build_window_program, decoder_tiny)
+from .sampling import SamplingParams, sample_token
 
 __all__ = [
     "ServingEngine", "GenRequest", "ContinuousBatchingScheduler",
-    "PagedKVPool", "pool_var_names", "create_device_pools",
+    "PagedKVPool", "PrefixCache", "pool_var_names", "create_device_pools",
     "DecoderConfig", "decoder_tiny", "build_prefill_program",
-    "build_decode_program", "build_full_forward_program",
+    "build_decode_program", "build_window_program",
+    "build_full_forward_program", "SamplingParams", "sample_token",
+    "ngram_draft",
 ]

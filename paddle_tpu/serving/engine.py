@@ -1,37 +1,65 @@
 """Continuous-batching serving engine over the paged KV cache.
 
-The scheduling loop the "millions of users" scenario needs (ROADMAP item 1):
+The scheduling loop the "millions of users" scenario needs (ROADMAP item 2):
 requests arrive at any time, and the engine admits/evicts them BETWEEN
 decode steps instead of running fixed generation batches:
 
     step():  (maybe) inject a chaos abort -> admit waiting requests while
-             pages + inflight slots allow (prefill each, bucketed) ->
-             grow/allocate pages for the next token slot (preempting the
-             youngest request on pool exhaustion) -> one ragged decode step
-             over ALL running requests -> retire finished rows.
+             pages + inflight slots allow (prefix-cache hits map shared
+             pages, then prefill ONLY the uncached suffix, bucketed) ->
+             grow/allocate/copy-on-write pages for the next write window
+             (preempting the youngest request on pool exhaustion) -> one
+             ragged decode step over ALL running requests (a k-token
+             draft-verify window when speculative decoding is on) ->
+             retire finished rows.
+
+Multi-tenant machinery (ISSUE 11), three composable stages:
+  * PREFIX CACHING — prompts are indexed at page granularity
+    (kv_cache.PrefixCache); a new request maps every cached full page of
+    its prompt with a refcount bump (`PagedKVPool.share`) and prefills only
+    the suffix through the windowed program (model.build_window_program).
+    Shared pages are immutable: the first write past the shared boundary
+    (e.g. a fully-cached prompt's first generated token re-writing the last
+    prompt slot) triggers COPY-ON-WRITE — a fresh page, one in-place
+    `kv_cache_copy_page` step, and the writer's table repointed, everyone
+    else untouched.
+  * SPECULATIVE DECODING — with FLAGS_serving_draft_k > 0 each decode step
+    self-drafts k tokens per row (n-gram continuation of the request's own
+    history) and verifies all k+1 positions in ONE batched window step;
+    the greedy tokens the verify emits are accepted up to the first draft
+    mismatch, so the result is EXACTLY the plain greedy sequence — only
+    (potentially) several tokens per step instead of one. Rejected drafts
+    cost nothing to roll back: their KV slots sit past the new context
+    length and are overwritten before they can ever be attended.
+  * TENSOR PARALLELISM — with tp > 1 the engine builds its programs over a
+    `tp` mesh (parallel/mesh.make_tp_mesh): attention heads and the KV pool
+    shard across the axis (model.apply_tp_annotations), and
+    `paged_decode_attention` keys the tuning DB on the PER-SHARD shape
+    (nh/tp) so TP decode resolves through the same swept verdicts as every
+    other lever.
 
 Compile discipline (the PR 2 machinery doing serving duty):
-  * prefill compiles once per prompt-length bucket (pow2 rounding, the
-    shape-bucketing convention);
+  * prefill compiles once per prompt-length bucket (pow2 rounding); suffix
+    prefill once per (suffix-bucket, page-bucket);
   * decode compiles once per (batch-bucket, page-count-bucket) — rows are
     padded up to the batch bucket and masked with the `batch_mask` row-mask
-    convention, page tables padded to the page bucket (masked by length);
+    convention (the verify window masks via zero valid-lengths instead);
   * `stats["prefill_signatures"]/["decode_signatures"]` record exactly which
     buckets compiled, so tests can assert the open-loop run compiled decode
     at most once per bucket (via pipeline.jit_compile_counter).
 
 Failure/backpressure semantics:
-  * admission backpressure: a request whose context needs more pages than
-    the free list holds (or when max_inflight is reached) WAITS — the pool
-    can never be oversubscribed;
+  * admission backpressure: a request whose context needs more private
+    pages than the free list holds (after evicting unshared prefix-cache
+    pages, LRU-first) WAITS — the pool can never be oversubscribed;
   * mid-decode growth: when a running request crosses a page boundary and
     the pool is dry, the YOUNGEST running request is preempted back to the
-    waiting queue (pages freed; on re-admission its prompt+generated prefix
-    is re-prefilled — recompute-style preemption, exact under greedy
-    decoding);
+    waiting queue (its refcounts released; on re-admission its
+    prompt+generated prefix re-prefills past whatever the prefix cache
+    still holds — recompute-style preemption, exact under greedy decoding);
   * abort (client gone, or the `serving_abort` chaos fault site): the
-    request's pages return to the free list immediately — the
-    zero-leak invariant the chaos test pins down.
+    request's refcounts release immediately; pages nobody else maps return
+    to the free list — the zero-leak invariant the chaos test pins down.
 """
 from __future__ import annotations
 
@@ -45,11 +73,37 @@ from ..executor import Executor, Scope
 from ..framework import Program, program_guard
 from ..resilience.faults import InjectedFault, fault_point
 from . import model as sv_model
-from .kv_cache import PagedKVPool, create_device_pools
+from .kv_cache import PagedKVPool, PrefixCache, create_device_pools
+from .sampling import SamplingParams, request_rng, sample_token
 
-__all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine"]
+__all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine",
+           "ngram_draft"]
 
 WAITING, RUNNING, FINISHED, ABORTED = "waiting", "running", "finished", "aborted"
+
+
+def ngram_draft(tokens, k: int, window: int = 128) -> list[int]:
+    """Self-drafting proposer: continue `tokens` with the k tokens that
+    followed the most recent earlier occurrence of its tail n-gram (longest
+    of 3/2/1), falling back to repeating the last token. No draft model,
+    no extra weights — the request's own history is the draft distribution,
+    which is exactly where decode traffic is redundant (templated outputs,
+    code, quoted context). Wrong drafts only cost their share of the
+    verify window; acceptance is checked exactly."""
+    if k <= 0:
+        return []
+    toks = [int(t) for t in tokens]
+    lo = max(0, len(toks) - window)
+    for glen in (3, 2, 1):
+        if len(toks) < glen + 1:
+            continue
+        tail = toks[-glen:]
+        for i in range(len(toks) - glen - 1, lo - 1, -1):
+            if toks[i:i + glen] == tail:
+                cont = toks[i + glen:i + glen + k]
+                if cont:
+                    return (cont + [toks[-1]] * (k - len(cont)))[:k]
+    return [toks[-1]] * k
 
 
 class GenRequest:
@@ -62,7 +116,8 @@ class GenRequest:
     separate bookkeeping for "how much cache survived".
     """
 
-    def __init__(self, rid: int, prompt, max_new_tokens: int, eos_id=None):
+    def __init__(self, rid: int, prompt, max_new_tokens: int, eos_id=None,
+                 sampling: "SamplingParams | None" = None):
         if not len(prompt):
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -72,9 +127,11 @@ class GenRequest:
         self.all_tokens: list[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        self.sampling = sampling or SamplingParams()
         self.state = WAITING
         self.pages: list[int] = []
-        self.admit_seq = -1  # admission order; preemption evicts the newest
+        self.cached_len = 0      # slots mapped from the prefix cache
+        self.admit_seq = -1      # admission order; preemption evicts the newest
         self.preemptions = 0
         self.arrival_t = time.perf_counter()
         self.t_first_token: float | None = None
@@ -127,7 +184,10 @@ class ServingEngine:
                  pool_pages: int | None = None,
                  max_inflight: int | None = None,
                  policy: str | None = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 prefix_cache: bool | None = None,
+                 draft_k: int | None = None,
+                 tp: int | None = None):
         self.cfg = cfg or sv_model.decoder_tiny()
         self.page_size = int(page_size
                              or flags.get_flag("serving_page_size"))
@@ -137,15 +197,36 @@ class ServingEngine:
                                 or flags.get_flag("serving_max_inflight"))
         self.scheduler = ContinuousBatchingScheduler(
             policy or str(flags.get_flag("serving_sched_policy")))
+        if prefix_cache is None:
+            prefix_cache = bool(flags.get_flag("serving_prefix_cache"))
+        self.draft_k = int(draft_k if draft_k is not None
+                           else flags.get_flag("serving_draft_k"))
+        self.tp = int(tp if tp is not None else flags.get_flag("serving_tp"))
+        if self.draft_k < 0:
+            raise ValueError(f"draft_k must be >= 0, got {self.draft_k}")
+        self.seed = int(seed)
         self.pool = PagedKVPool(self.pool_pages, self.page_size)
+        self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
         self._exe = Executor()
         self._scope = Scope()
 
+        self._mesh = None
+        if self.tp > 1:
+            from ..parallel.mesh import make_tp_mesh
+
+            if self.cfg.num_heads % self.tp:
+                raise ValueError(
+                    f"serving tp degree {self.tp} must divide num_heads "
+                    f"{self.cfg.num_heads} (head-sharded decode)")
+            self._mesh = make_tp_mesh(self.tp)
+
         self._prefill_prog = Program()
         self._decode_prog = Program()
+        self._window_prog = Program()
+        self._cow_prog = Program()
         startup = Program()
-        decoy_startup = Program()  # decode re-declares params; inits unused
-        self._prefill_prog.random_seed = startup.random_seed = int(seed)
+        decoy_startup = Program()  # non-prefill progs re-declare; inits unused
+        self._prefill_prog.random_seed = startup.random_seed = self.seed
         with program_guard(self._prefill_prog, startup), \
                 unique_name.guard():
             self._prefill_io = sv_model.build_prefill_program(
@@ -153,12 +234,24 @@ class ServingEngine:
         with program_guard(self._decode_prog, decoy_startup), \
                 unique_name.guard():
             self._decode_io = sv_model.build_decode_program(
+                self.cfg, self.pool_pages, self.page_size, tp=self.tp)
+        with program_guard(self._window_prog, decoy_startup), \
+                unique_name.guard():
+            self._window_io = sv_model.build_window_program(
+                self.cfg, self.pool_pages, self.page_size, tp=self.tp)
+        with program_guard(self._cow_prog, decoy_startup), \
+                unique_name.guard():
+            self._cow_io = sv_model.build_cow_program(
                 self.cfg, self.pool_pages, self.page_size)
         self._exe.run(startup, scope=self._scope)
         create_device_pools(self._scope, self.cfg.num_layers,
                             self.pool_pages, self.page_size,
                             self.cfg.num_heads, self.cfg.head_dim,
                             self.cfg.dtype)
+        self._prefill_run = self._exec_target(self._prefill_prog)
+        self._decode_run = self._exec_target(self._decode_prog)
+        self._window_run = self._exec_target(self._window_prog)
+        self._cow_run = self._exec_target(self._cow_prog)
 
         self.requests: dict[int, GenRequest] = {}
         self._waiting: list[GenRequest] = []
@@ -170,24 +263,101 @@ class ServingEngine:
             "preemptions": 0, "aborts": 0,
             "prefill_signatures": set(), "decode_signatures": set(),
             "peak_pages_in_use": 0, "occupancy_sum": 0.0, "occupancy_n": 0,
+            # prefix caching (ISSUE 11)
+            "prefill_tokens_computed": 0, "prefix_hit_tokens": 0,
+            "prefix_lookups": 0, "prefix_full_hits": 0, "cow_copies": 0,
+            # speculative decoding (ISSUE 11)
+            "spec_steps": 0, "spec_proposed": 0, "spec_accepted": 0,
         }
 
+    def warmup_decode(self, max_context: int | None = None) -> int:
+        """Precompile the decode-step signature lattice for contexts up to
+        `max_context` (default max_position): which (batch-bucket,
+        page-bucket) a step hits depends on how many requests HAPPEN to be
+        running — pure load timing — so organic warmup can leave signatures
+        uncompiled and a mid-measurement XLA compile (~1s on CPU) then
+        decides an open-loop verdict instead of the engines. Drives every
+        signature with fully-masked rows (zero valid lengths): writes drop,
+        outputs are ignored, no engine state moves. Returns the signature
+        count."""
+        max_context = min(int(max_context or self.cfg.max_position),
+                          self.cfg.max_position)
+        pbs = sorted({_round_up_pow2(self.pool.pages_for(c))
+                      for c in range(1, max_context + 2)})
+        bbs = sorted({_round_up_pow2(b)
+                      for b in range(1, self.max_inflight + 1)})
+        n = 0
+        for bb in bbs:
+            for pb in pbs:
+                pages = np.zeros((bb, pb), np.int32)
+                if self.draft_k > 0:
+                    S = self.draft_k + 1
+                    feed = {sv_model.TOK_FEED: np.zeros((bb, S), np.int32),
+                            sv_model.POS_FEED: np.zeros((bb, S), np.int32),
+                            sv_model.PAGES_FEED: pages,
+                            sv_model.START_FEED: np.zeros((bb,), np.int32),
+                            sv_model.LEN_FEED: np.zeros((bb,), np.int32)}
+                    self._exe.run(self._window_run, feed=feed,
+                                  fetch_list=[self._window_io["tokens"],
+                                              self._window_io["logits"]],
+                                  scope=self._scope)
+                else:
+                    feed = {sv_model.TOK_FEED: np.zeros((bb, 1), np.int32),
+                            sv_model.POS_FEED: np.zeros((bb,), np.int32),
+                            sv_model.PAGES_FEED: pages,
+                            sv_model.MASK_FEED: np.zeros((bb, 1),
+                                                         np.float32)}
+                    self._exe.run(self._decode_run, feed=feed,
+                                  fetch_list=[self._decode_io["next_token"],
+                                              self._decode_io["logits"]],
+                                  scope=self._scope)
+                n += 1
+        return n
+
+    def reset_stats(self) -> None:
+        """Zero the counters (and the compile-signature sets) without
+        touching the executor compile cache, the pool, or the prefix
+        cache — the steady-state measurement boundary: warm the engine on
+        one pass of a workload, reset, measure the second pass."""
+        for k, v in self.stats.items():
+            if isinstance(v, set):
+                v.clear()
+            elif isinstance(v, float):
+                self.stats[k] = 0.0
+            else:
+                self.stats[k] = 0
+
+    def _exec_target(self, prog: Program):
+        """The executor target for `prog`: the bare program single-chip, a
+        GSPMD CompiledProgram over the tp mesh when sharded (built ONCE so
+        the executor compile cache keys stay stable)."""
+        if self._mesh is None:
+            return prog
+        from ..compiler import CompiledProgram
+
+        sv_model.apply_tp_annotations(prog, self.cfg, self.tp)
+        return CompiledProgram(prog).with_data_parallel(mesh=self._mesh)
+
     # -- client API ---------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int, eos_id=None) -> int:
+    def submit(self, prompt, max_new_tokens: int, eos_id=None,
+               sampling: "SamplingParams | dict | None" = None) -> int:
         if len(prompt) + max_new_tokens > self.cfg.max_position:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_position {self.cfg.max_position}")
+        if isinstance(sampling, dict):
+            sampling = SamplingParams(**sampling)
         rid = self._next_rid
         self._next_rid += 1
-        req = GenRequest(rid, prompt, max_new_tokens, eos_id)
+        req = GenRequest(rid, prompt, max_new_tokens, eos_id, sampling)
         self.requests[rid] = req
         self._waiting.append(req)
         return rid
 
     def abort(self, rid: int) -> None:
-        """Drop a request wherever it is; its pages return to the free list
-        immediately (the zero-leak contract the chaos test asserts)."""
+        """Drop a request wherever it is; its page refcounts release
+        immediately and pages nobody else maps return to the free list
+        (the zero-leak contract the chaos test asserts)."""
         req = self.requests.get(rid)
         if req is None or req.state in (FINISHED, ABORTED):
             return
@@ -205,6 +375,23 @@ class ServingEngine:
 
     def result(self, rid: int) -> list[int]:
         return list(self.requests[rid].out_tokens)
+
+    def leaked_pages(self) -> int:
+        """Pages in use that NO live request and NO prefix-cache entry can
+        account for — must be zero at every quiescent point."""
+        mapped: set[int] = set()
+        for r in self.requests.values():
+            mapped.update(r.pages)
+        if self.prefix_cache is not None:
+            mapped.update(n.page for n in self.prefix_cache._nodes.values())
+        return self.pool.pages_in_use - len(mapped)
+
+    def flush_prefix_cache(self) -> int:
+        """Evict every prefix-cache entry no live request still maps (frees
+        their pages). Returns pages freed."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.flush()
 
     def run_until_drained(self, max_steps: int = 100_000) -> None:
         steps = 0
@@ -250,8 +437,22 @@ class ServingEngine:
     # -- internals ----------------------------------------------------------
     def _release(self, req: GenRequest) -> None:
         if req.pages:
-            self.pool.free(req.pages)
+            self.pool.release(req.pages)
             req.pages = []
+        req.cached_len = 0
+
+    def _allocate(self, n: int) -> list[int] | None:
+        """allocate() with prefix-cache pressure relief: when the free list
+        runs dry, evict unshared cache entries (LRU-first) before giving
+        up — cached prompts are a performance bet, never a reason to queue
+        live work."""
+        if n <= 0:
+            return []
+        got = self.pool.allocate(n)
+        if got is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(n - self.pool.free_count)
+            got = self.pool.allocate(n)
+        return got
 
     def _note_occupancy(self) -> None:
         used = self.pool.pages_in_use
@@ -264,17 +465,28 @@ class ServingEngine:
         """Admit waiting requests in policy order until pages or inflight
         slots run out. Head-of-line backpressure: the first request that
         does not fit stops admission (no starvation of big requests by
-        later small ones under fcfs)."""
+        later small ones under fcfs). Prefix-cache hits cut the PRIVATE
+        page bill: cached full pages of the prompt map with a refcount
+        bump instead of an allocation."""
         admitted = 0
         for req in self.scheduler.order(self._waiting):
             if len(self._running) >= self.max_inflight:
                 break
+            matched: list[int] = []
+            if self.prefix_cache is not None:
+                self.stats["prefix_lookups"] += 1
+                matched = self.prefix_cache.match(
+                    req.all_tokens[:req.prompt_len])
             # +1: the decode step after prefill writes one more slot
             need = self.pool.pages_for(len(req.all_tokens) + 1)
-            pages = self.pool.allocate(need)
-            if pages is None:
+            private = self._allocate(need - len(matched))
+            if private is None:
                 break
-            req.pages = pages
+            if matched:
+                self.pool.share(matched)
+            req.pages = matched + private
+            req.cached_len = len(matched) * self.page_size
+            self.stats["prefix_hit_tokens"] += req.cached_len
             self._waiting.remove(req)
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
@@ -285,29 +497,83 @@ class ServingEngine:
     def _seq_bucket(self, n: int) -> int:
         return min(self.cfg.max_position, max(8, _round_up_pow2(n)))
 
+    def _first_token(self, req: GenRequest, nxt, last_logits) -> int:
+        """The prompt's first generated token: compiled argmax for greedy
+        requests, the host-side seeded sampler otherwise."""
+        if req.sampling.is_greedy:
+            return int(np.asarray(nxt).reshape(-1)[0])
+        rng = request_rng(self.seed, req.rid, req.n_generated)
+        return sample_token(np.asarray(last_logits)[0], req.sampling, rng)
+
     def _prefill(self, req: GenRequest) -> None:
-        """Run the bucketed prefill for one request: writes its context's
-        K/V into its pages and produces its first new token."""
+        """Materialize one request's context KV and (unless the whole
+        prompt was cached) its first new token.
+
+        Three regimes by prefix-cache depth: cold (classic whole-prompt
+        prefill), suffix (cached_len slots mapped shared — only the suffix
+        runs, through the windowed program), full hit (every prompt page
+        mapped — NO prefill compute at all; the next decode step re-derives
+        the last prompt slot under copy-on-write and emits token one)."""
         n = len(req.all_tokens)
-        sb = self._seq_bucket(n)
-        pb = max(len(req.pages), self.pool.pages_for(sb))
-        tok = np.zeros((1, sb), np.int32)
-        tok[0, :n] = req.all_tokens
-        pos = np.arange(sb, dtype=np.int32)[None, :]
-        pos = np.minimum(pos, self.cfg.max_position - 1)
-        pages = np.zeros((1, pb), np.int32)
-        pages[0, :len(req.pages)] = req.pages
-        feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
-                sv_model.PAGES_FEED: pages,
-                sv_model.LEN_FEED: np.asarray([n], np.int32)}
-        (nxt,) = self._exe.run(self._prefill_prog, feed=feed,
-                               fetch_list=[self._prefill_io["next_token"]],
-                               scope=self._scope)
         req.state = RUNNING
         self._running.append(req)
+        if req.cached_len >= n:
+            self.stats["prefix_full_hits"] += 1
+            self._register_prefix(req)
+            return
+        if req.cached_len > 0:
+            suf = n - req.cached_len
+            sb = self._seq_bucket(suf)
+            pb = _round_up_pow2(max(len(req.pages),
+                                    self.pool.pages_for(req.cached_len + sb)))
+            tok = np.zeros((1, sb), np.int32)
+            tok[0, :suf] = req.all_tokens[req.cached_len:]
+            pos = req.cached_len + np.arange(sb, dtype=np.int32)[None, :]
+            pos = np.minimum(pos, self.cfg.max_position - 1)
+            pages = np.zeros((1, pb), np.int32)
+            pages[0, :len(req.pages)] = req.pages
+            feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
+                    sv_model.PAGES_FEED: pages,
+                    sv_model.START_FEED: np.asarray([req.cached_len],
+                                                    np.int32),
+                    sv_model.LEN_FEED: np.asarray([suf], np.int32)}
+            nxt, lg = self._exe.run(
+                self._window_run, feed=feed,
+                fetch_list=[self._window_io["next_token"],
+                            self._window_io["last_logits"]],
+                scope=self._scope)
+            self.stats["prefill_signatures"].add(("suffix", sb, pb))
+            self.stats["prefill_tokens_computed"] += suf
+        else:
+            sb = self._seq_bucket(n)
+            pb = max(len(req.pages), self.pool.pages_for(sb))
+            tok = np.zeros((1, sb), np.int32)
+            tok[0, :n] = req.all_tokens
+            pos = np.arange(sb, dtype=np.int32)[None, :]
+            pos = np.minimum(pos, self.cfg.max_position - 1)
+            pages = np.zeros((1, pb), np.int32)
+            pages[0, :len(req.pages)] = req.pages
+            feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
+                    sv_model.PAGES_FEED: pages,
+                    sv_model.LEN_FEED: np.asarray([n], np.int32)}
+            nxt, lg = self._exe.run(
+                self._prefill_run, feed=feed,
+                fetch_list=[self._prefill_io["next_token"],
+                            self._prefill_io["last_logits"]],
+                scope=self._scope)
+            self.stats["prefill_signatures"].add((sb, pb))
+            self.stats["prefill_tokens_computed"] += n
         self.stats["prefills"] += 1
-        self.stats["prefill_signatures"].add((sb, pb))
-        self._accept_token(req, int(np.asarray(nxt).reshape(-1)[0]))
+        self._register_prefix(req)
+        self._accept_token(req, self._first_token(req, nxt, lg))
+
+    def _register_prefix(self, req: GenRequest) -> None:
+        """Index the request's full PROMPT pages so later arrivals sharing
+        the prompt map them instead of recomputing. The cache takes its own
+        refcount per page, so the entries outlive the request."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.all_tokens[:req.prompt_len],
+                                     req.pages)
 
     def _accept_token(self, req: GenRequest, tok: int) -> None:
         req.all_tokens.append(tok)
@@ -321,16 +587,53 @@ class ServingEngine:
             req.state = FINISHED
             req.t_done = now
 
-    def _ensure_pages(self) -> None:
-        """Every running request must own the page its next slot lands in;
-        on pool exhaustion preempt the youngest (recompute-style)."""
+    def _cow(self, req: GenRequest, ordinal: int) -> bool:
+        """Copy-on-write req's page `ordinal`: fresh page, one in-place
+        device copy across every layer's K/V pools, table repointed, old
+        refcount released (other holders untouched). Returns False when the
+        pool pressure this created preempted `req` itself."""
+        new = self._allocate(1)
+        while new is None:
+            victim = max(self._running, key=lambda r: r.admit_seq)
+            if victim is req and len(self._running) == 1:
+                raise RuntimeError(
+                    f"request {req.rid} needs a copy-on-write page but the "
+                    f"pool ({self.pool.num_pages} pages) is exhausted with "
+                    f"nothing left to preempt")
+            self._preempt(victim)
+            if victim is req:
+                return False
+            new = self._allocate(1)
+        old = req.pages[ordinal]
+        self._exe.run(self._cow_run, feed={
+            sv_model.COW_SRC_FEED: np.asarray([old], np.int32),
+            sv_model.COW_DST_FEED: np.asarray([new[0]], np.int32)},
+            fetch_list=[], scope=self._scope)
+        self.pool.release([old])
+        req.pages[ordinal] = new[0]
+        self.stats["cow_copies"] += 1
+        return True
+
+    def _ensure_writable(self, lookahead: int = 0) -> dict[int, int]:
+        """Every running request must OWN every page its next write window
+        [cache_len, cache_len + lookahead] touches, and own it EXCLUSIVELY
+        (refcount 1) — shared pages copy-on-write first. On pool exhaustion
+        the lookahead shrinks before anyone is preempted (speculative slots
+        are optional; the required slot is cache_len's). Returns per-rid
+        granted lookahead."""
+        ps = self.page_size
+        granted: dict[int, int] = {}
         for req in list(self._running):
             if req.state != RUNNING:
                 continue
-            while req.cache_len // self.page_size >= len(req.pages):
-                got = self.pool.allocate(1)
+            extra = lookahead
+            while (req.cache_len + extra) // ps >= len(req.pages):
+                got = self._allocate(1)
                 if got is not None:
                     req.pages.extend(got)
+                    continue
+                if extra > 0:
+                    extra -= 1
                     continue
                 victim = max(self._running, key=lambda r: r.admit_seq)
                 if victim is req and len(self._running) == 1:
@@ -342,6 +645,18 @@ class ServingEngine:
                 self._preempt(victim)
                 if victim is req:
                     break
+            if req.state != RUNNING:
+                continue
+            top = min(req.cache_len + extra, len(req.pages) * ps - 1)
+            ok = True
+            for o in range(req.cache_len // ps, top // ps + 1):
+                if self.pool.refcount(req.pages[o]) > 1:
+                    if not self._cow(req, o):
+                        ok = False
+                        break
+            if ok and req.state == RUNNING:
+                granted[req.rid] = extra
+        return granted
 
     def _preempt(self, req: GenRequest) -> None:
         self._running.remove(req)
@@ -354,7 +669,9 @@ class ServingEngine:
         self._waiting.insert(0, req)
 
     def _decode_once(self) -> bool:
-        self._ensure_pages()
+        if self.draft_k > 0:
+            return self._decode_spec()
+        self._ensure_writable(0)
         rows = [r for r in self._running if r.state == RUNNING]
         if not rows:
             return False
@@ -371,13 +688,93 @@ class ServingEngine:
             mask[i, 0] = 1.0
         feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
                 sv_model.PAGES_FEED: pages, sv_model.MASK_FEED: mask}
-        (nxt,) = self._exe.run(self._decode_prog, feed=feed,
-                               fetch_list=[self._decode_io["next_token"]],
-                               scope=self._scope)
+        nxt, lg = self._exe.run(
+            self._decode_run, feed=feed,
+            fetch_list=[self._decode_io["next_token"],
+                        self._decode_io["logits"]],
+            scope=self._scope)
         nxt = np.asarray(nxt).reshape(-1)
         self.stats["decode_steps"] += 1
         self.stats["decode_signatures"].add((bb, pb))
+        lg = None if all(r.sampling.is_greedy for r in rows) \
+            else np.asarray(lg)
         for i, r in enumerate(rows):
+            if r.sampling.is_greedy:
+                t = int(nxt[i])
+            else:
+                rng = request_rng(self.seed, r.rid, r.n_generated)
+                t = sample_token(lg[i], r.sampling, rng)
             self.stats["decode_tokens"] += 1
-            self._accept_token(r, int(nxt[i]))
+            self._accept_token(r, t)
+        return True
+
+    def _decode_spec(self) -> bool:
+        """One draft-verify window step: propose k tokens per row
+        (ngram_draft over the row's own history), run all k+1 positions
+        through the windowed program in ONE compiled step, and accept the
+        verify's greedy tokens up to the first draft mismatch — bitwise the
+        plain greedy sequence, 1..k+1 tokens per step."""
+        k = self.draft_k
+        S = k + 1
+        granted = self._ensure_writable(k)
+        rows = [r for r in self._running if r.state == RUNNING
+                and r.rid in granted]
+        if not rows:
+            return False
+        plans = []
+        for r in rows:
+            n_valid = min(S,
+                          self.cfg.max_position - len(r.all_tokens),
+                          r.max_new_tokens - r.n_generated,
+                          granted.get(r.rid, 0) + 1)
+            plans.append((r, max(1, n_valid),
+                          ngram_draft(r.all_tokens, k)))
+        bb = min(_round_up_pow2(len(rows)), _round_up_pow2(self.max_inflight))
+        pb = _round_up_pow2(max(len(r.pages) for r in rows))
+        tok = np.zeros((bb, S), np.int32)
+        pos = np.zeros((bb, S), np.int32)
+        pages = np.zeros((bb, pb), np.int32)
+        start = np.zeros((bb,), np.int32)
+        lens = np.zeros((bb,), np.int32)
+        for i, (r, n_valid, drafts) in enumerate(plans):
+            tok[i, 0] = r.all_tokens[-1]
+            tok[i, 1:] = drafts
+            pos[i] = np.minimum(r.cache_len + np.arange(S),
+                                self.cfg.max_position - 1)
+            pages[i, :len(r.pages)] = r.pages
+            start[i] = r.cache_len
+            lens[i] = n_valid
+        feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
+                sv_model.PAGES_FEED: pages, sv_model.START_FEED: start,
+                sv_model.LEN_FEED: lens}
+        toks, lg = self._exe.run(
+            self._window_run, feed=feed,
+            fetch_list=[self._window_io["tokens"],
+                        self._window_io["logits"]],
+            scope=self._scope)
+        toks = np.asarray(toks)
+        self.stats["decode_steps"] += 1
+        self.stats["spec_steps"] += 1
+        self.stats["decode_signatures"].add((bb, pb))
+        lg = None if all(r.sampling.is_greedy for r, _, _ in plans) \
+            else np.asarray(lg)
+        for i, (r, n_valid, drafts) in enumerate(plans):
+            if not r.sampling.is_greedy:
+                # sampling rows take exactly one (seeded) token per step;
+                # draft acceptance is a greedy-only contract
+                rng = request_rng(self.seed, r.rid, r.n_generated)
+                t = sample_token(lg[i, 0], r.sampling, rng)
+                self.stats["decode_tokens"] += 1
+                self._accept_token(r, t)
+                continue
+            m = 0
+            while m < n_valid - 1 and int(drafts[m]) == int(toks[i, m]):
+                m += 1
+            self.stats["spec_proposed"] += n_valid - 1
+            self.stats["spec_accepted"] += m
+            for j in range(m + 1):
+                if r.state != RUNNING:
+                    break
+                self.stats["decode_tokens"] += 1
+                self._accept_token(r, int(toks[i, j]))
         return True

@@ -11,8 +11,16 @@ concurrent requests — not compute. Instead:
     read AND write (the executor donates the buffers, so every append is an
     in-place HBM scatter, never a reallocation);
   * the HOST side (this module) is pure bookkeeping: a free-list of page
-    ids and a per-request page table (list of page ids). allocate/free are
-    O(pages moved); nothing here touches the device.
+    ids, a PER-PAGE REFCOUNT, and a per-request page table (list of page
+    ids). allocate/share/release are O(pages moved); nothing here touches
+    the device.
+
+Multi-tenancy (ISSUE 11) rides the refcounts: requests sharing a system
+prompt map the SAME physical pages into their page tables (`share` — a
+refcount bump, not a copy), and the `PrefixCache` below keeps prompt pages
+alive past their request's lifetime so later arrivals reuse them. A page
+returns to the free list only when its LAST holder releases it; a holder
+that wants to WRITE a shared page must copy-on-write first (engine.py).
 
 Admission control is the caller's job (engine.py): `can_allocate` is the
 backpressure predicate — when the free list runs dry, new requests queue
@@ -23,8 +31,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["PagedKVPool", "pool_var_names", "create_device_pools",
-           "declare_pool_vars"]
+__all__ = ["PagedKVPool", "PrefixCache", "pool_var_names",
+           "create_device_pools", "declare_pool_vars"]
 
 
 def pool_var_names(num_layers: int) -> list[tuple[str, str]]:
@@ -37,11 +45,13 @@ def declare_pool_vars(block, num_layers: int, num_pages: int, page_size: int,
                       num_heads: int, head_dim: int, dtype: str = "float32"):
     """Declare the pool vars in a program block (both the prefill and the
     decode program must see them so the executor's def-use analysis
-    classifies them read-write and donates their buffers)."""
+    classifies them read-write and donates their buffers). Under TP,
+    model.apply_tp_annotations shards their heads dim afterwards."""
     for kn, vn in pool_var_names(num_layers):
         for name in (kn, vn):
             block.create_var(name=name,
-                             shape=[num_pages, page_size, num_heads, head_dim],
+                             shape=[num_pages, page_size, num_heads,
+                                    head_dim],
                              dtype=dtype, persistable=True,
                              stop_gradient=True)
 
@@ -59,7 +69,7 @@ def create_device_pools(scope, num_layers: int, num_pages: int,
 
 
 class PagedKVPool:
-    """Free-list allocator over `num_pages` page ids.
+    """Refcounted free-list allocator over `num_pages` page ids.
 
     Deliberately not thread-safe: the continuous-batching engine owns it
     from one scheduler thread (the compiled steps carry the parallelism).
@@ -76,6 +86,7 @@ class PagedKVPool:
         # LIFO free list: recently-freed pages are re-used first, keeping
         # the pool's hot working set small
         self._free: list[int] = list(range(self.num_pages - 1, -1, -1))
+        self._refs: list[int] = [0] * self.num_pages
 
     # -- sizing ---------------------------------------------------------------
     def pages_for(self, n_tokens: int) -> int:
@@ -93,24 +104,194 @@ class PagedKVPool:
     def occupancy(self) -> float:
         return self.pages_in_use / self.num_pages
 
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
+
     # -- allocation -----------------------------------------------------------
     def can_allocate(self, n: int) -> bool:
         return n <= len(self._free)
 
     def allocate(self, n: int) -> list[int] | None:
-        """Pop `n` page ids, or None (backpressure — never a partial grab,
-        so a failed admission leaves the pool exactly as it found it)."""
+        """Pop `n` page ids at refcount 1, or None (backpressure — never a
+        partial grab, so a failed admission leaves the pool exactly as it
+        found it)."""
         if n > len(self._free):
             return None
         got = self._free[-n:]
         del self._free[-n:]
+        for p in got:
+            self._refs[p] = 1
         return got
 
-    def free(self, pages: list[int]) -> None:
+    def share(self, pages: list[int]) -> None:
+        """Add one holder to each page (prefix reuse: a refcount bump, not a
+        copy). Only live pages can be shared — sharing a free page would
+        resurrect garbage."""
+        for p in pages:
+            if not (0 <= p < self.num_pages):
+                raise ValueError(f"sharing page {p} outside pool "
+                                 f"[0, {self.num_pages})")
+            if self._refs[p] <= 0:
+                raise ValueError(f"sharing free page {p} (refcount 0)")
+        for p in pages:
+            self._refs[p] += 1
+
+    def release(self, pages: list[int]) -> int:
+        """Drop one holder from each page; pages whose refcount hits zero
+        return to the free list. Returns how many pages were actually freed.
+        Releasing below zero (a double-free) raises BEFORE any mutation."""
+        counts: dict[int, int] = {}
         for p in pages:
             if not (0 <= p < self.num_pages):
                 raise ValueError(f"freeing page {p} outside pool "
                                  f"[0, {self.num_pages})")
-            if p in self._free:
-                raise ValueError(f"double-free of page {p}")
-        self._free.extend(pages)
+            counts[p] = counts.get(p, 0) + 1
+        for p, c in counts.items():
+            if c > self._refs[p]:
+                raise ValueError(
+                    f"double-free of page {p} (releasing {c} holders, "
+                    f"refcount {self._refs[p]})")
+        freed = 0
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
+    def free(self, pages: list[int]) -> None:
+        """Single-holder spelling of `release` (the PR 7 API)."""
+        self.release(pages)
+
+
+class _PrefixNode:
+    __slots__ = ("nid", "page", "key", "parent_id", "children", "last_use")
+
+    def __init__(self, nid, page, key, parent_id):
+        self.nid = nid
+        self.page = page
+        self.key = key              # (parent_id, token_block) — exact match
+        self.parent_id = parent_id
+        self.children = 0
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Prefix index keyed on token-prefix hashes at PAGE granularity.
+
+    A trie over full token blocks: node (parent, tuple_of_page_size_tokens)
+    -> physical page id holding exactly that block's KV. The cache itself
+    holds one refcount on every indexed page, so prompt pages survive their
+    request and later requests with the same system prompt map them with a
+    `share` instead of re-prefilling (the copy-on-write discipline in
+    engine.py keeps them immutable). Keys are EXACT token tuples chained
+    through parent ids — a hash collision can therefore never map the wrong
+    page (correctness does not ride Python's hash).
+
+    Eviction is LRU over leaf nodes whose page nobody else holds
+    (refcount 1 == the cache's own ref): evicting a shared page would free
+    no HBM anyway, and an interior node can't go before its children or the
+    chain below it would dangle.
+    """
+
+    def __init__(self, pool: PagedKVPool):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self._nodes: dict[tuple, _PrefixNode] = {}
+        self._by_id: dict[int, _PrefixNode] = {}
+        self._next_id = 1
+        self._clock = 0
+        self.lookups = 0
+        self.hit_pages = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    @property
+    def pages_held(self) -> int:
+        return len(self._nodes)
+
+    def match(self, tokens) -> list[int]:
+        """Longest chain of cached pages covering a prefix of `tokens`
+        (full blocks only). Bumps LRU stamps on the path."""
+        self.lookups += 1
+        pages: list[int] = []
+        pid = 0
+        for i in range(len(tokens) // self.page_size):
+            block = tuple(int(t) for t in
+                          tokens[i * self.page_size:(i + 1) * self.page_size])
+            node = self._nodes.get((pid, block))
+            if node is None:
+                break
+            node.last_use = self._tick()
+            pages.append(node.page)
+            pid = node.nid
+        self.hit_pages += len(pages)
+        return pages
+
+    def insert(self, tokens, pages: list[int]) -> int:
+        """Index `tokens`' full blocks onto `pages` (pages[i] must hold
+        block i's KV, already written). New nodes take a cache refcount via
+        pool.share; blocks already indexed are left on their existing page
+        (first writer wins — both copies hold identical KV). Returns the
+        number of pages newly indexed."""
+        pid = 0
+        added = 0
+        for i in range(len(tokens) // self.page_size):
+            block = tuple(int(t) for t in
+                          tokens[i * self.page_size:(i + 1) * self.page_size])
+            key = (pid, block)
+            node = self._nodes.get(key)
+            if node is None:
+                self.pool.share([pages[i]])
+                node = _PrefixNode(self._next_id, pages[i], key, pid)
+                self._next_id += 1
+                self._nodes[key] = node
+                self._by_id[node.nid] = node
+                if pid:
+                    self._by_id[pid].children += 1
+                added += 1
+                self.inserted_pages += 1
+            node.last_use = self._tick()
+            pid = node.nid
+        return added
+
+    def _evictable(self):
+        return (n for n in self._nodes.values()
+                if n.children == 0 and self.pool.refcount(n.page) == 1)
+
+    def evict(self, need: int) -> int:
+        """Release up to `need` pages back to the free list, LRU-first over
+        evictable leaves. Returns pages actually freed (may be < need when
+        every remaining page is still mapped by a live request)."""
+        freed = 0
+        while freed < need:
+            victim = min(self._evictable(),
+                         key=lambda n: n.last_use, default=None)
+            if victim is None:
+                break
+            self._drop(victim)
+            freed += 1
+        return freed
+
+    def _drop(self, node: _PrefixNode) -> None:
+        del self._nodes[node.key]
+        del self._by_id[node.nid]
+        if node.parent_id:
+            self._by_id[node.parent_id].children -= 1
+        self.pool.release([node.page])
+        self.evicted_pages += 1
+
+    def flush(self) -> int:
+        """Evict every evictable entry (end-of-run accounting / tests):
+        afterwards the only indexed pages left are ones a live request
+        still maps."""
+        total = 0
+        while True:
+            freed = self.evict(len(self._nodes) or 1)
+            total += freed
+            if freed == 0:
+                return total

@@ -32,14 +32,18 @@ from ..param_attr import ParamAttr
 from .kv_cache import declare_pool_vars, pool_var_names
 
 __all__ = ["DecoderConfig", "decoder_tiny", "build_prefill_program",
-           "build_decode_program", "build_full_forward_program"]
+           "build_decode_program", "build_window_program",
+           "build_full_forward_program", "apply_tp_annotations"]
 
 # feed names shared by the engine and the programs
 TOK_FEED = "sv_tok"
 POS_FEED = "sv_pos"
 PAGES_FEED = "sv_pages"
 LEN_FEED = "sv_len"
+START_FEED = "sv_start"   # first global slot of a prefill/verify window
 MASK_FEED = "batch_mask"  # the PR 2 row-mask convention (data_feeder)
+COW_SRC_FEED = "sv_cow_src"  # copy-on-write: source page id
+COW_DST_FEED = "sv_cow_dst"  # copy-on-write: destination page id
 
 
 @dataclass
@@ -160,10 +164,106 @@ def build_prefill_program(cfg: DecoderConfig, num_pages: int, page_size: int):
                      {"X": [logits], "Lens": [lens]}, {"Out": [last]}, {})
     nxt = _greedy(last)
     return {"feeds": [TOK_FEED, POS_FEED, PAGES_FEED, LEN_FEED],
-            "next_token": nxt}
+            "next_token": nxt, "last_logits": last}
 
 
-def build_decode_program(cfg: DecoderConfig, num_pages: int, page_size: int):
+def _window_layer(x, i, cfg: DecoderConfig, pages, start, lens, tp: int):
+    """One decoder layer over a WINDOW of S query tokens whose context lives
+    in the paged pool: write the window's K/V at slots start+s (s < lens,
+    local), then attend over the pool — cached prefix, fresh window and all.
+    Shared by suffix prefill (ISSUE 11 prefix caching) and the speculative
+    verify step (S = draft k + 1)."""
+    name = _layer_names(i)
+    dh = cfg.head_dim
+    q, k, v = _qkv_heads_seq(x, cfg, name + ".mha")
+    kn, vn = pool_var_names(cfg.num_layers)[i]
+    helper = LayerHelper("kv_cache_prefill_write")
+    helper.append_op(
+        "kv_cache_prefill_write",
+        {"KPool": [kn], "VPool": [vn], "K": [k], "V": [v],
+         "PageTable": [pages], "Lens": [lens], "Start": [start]},
+        {"KPoolOut": [kn], "VPoolOut": [vn]}, {})
+    helper = LayerHelper("paged_prefill_attention")
+    att = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "paged_prefill_attention",
+        {"Q": [q], "KPool": [kn], "VPool": [vn],
+         "PageTable": [pages], "Start": [start]},
+        {"Out": [att]}, {"sm_scale": dh ** -0.5, "tp_degree": tp})
+    ctxv = L.reshape(L.transpose(att, perm=[0, 2, 1, 3]),
+                     shape=[0, 0, cfg.hidden_size])
+    a = _proj(ctxv, cfg.hidden_size, name + ".mha.out")
+    x = _ln(L.elementwise_add(x, a), name + ".ln1")
+    return _ffn_block(x, cfg, name)
+
+
+def build_window_program(cfg: DecoderConfig, num_pages: int, page_size: int,
+                         tp: int = 1):
+    """Build (in the current default main program) the windowed forward the
+    two ISSUE 11 stages share:
+
+      * suffix prefill — a prompt whose first Start slots are already in
+        the pool (prefix-cache hit) runs ONLY its uncached suffix through
+        the model; the window's K/V is appended at slots Start+s and the
+        window attends over the whole pooled context, so the prefill
+        compute drops from O(prompt) to O(suffix);
+      * speculative verify — S = k+1 query tokens per row ([last_token,
+        draft_1..draft_k]) in ONE batched step; `tokens` holds the greedy
+        next token at every window position, which the engine compares
+        against the drafts for exact greedy acceptance.
+
+    Feeds: sv_tok/sv_pos [B, S] int32, sv_pages [B, P] int32, sv_start [B]
+    int32 (global slot of window position 0), sv_len [B] int32 (valid LOCAL
+    window positions; 0 = padded row, writes nothing). Fetches:
+    `next_token` [B] (greedy token after local position Lens-1 — the suffix
+    prefill's output), `tokens` [B, S] (greedy token after every window
+    position — the verify output), `logits` [B, S, V] (the sampling
+    suite's input)."""
+    tok = L.data(name=TOK_FEED, shape=[cfg.max_position], dtype="int32")
+    pos = L.data(name=POS_FEED, shape=[cfg.max_position], dtype="int32")
+    pages = L.data(name=PAGES_FEED, shape=[1], dtype="int32")
+    start = L.data(name=START_FEED, shape=[], dtype="int32")
+    lens = L.data(name=LEN_FEED, shape=[], dtype="int32")
+    declare_pool_vars(default_main_program().global_block, cfg.num_layers,
+                      num_pages, page_size, cfg.num_heads, cfg.head_dim,
+                      cfg.dtype)
+    x = _embed(tok, pos, cfg)
+    for i in range(cfg.num_layers):
+        x = _window_layer(x, i, cfg, pages, start, lens, tp)
+    logits = _head(x, cfg)                             # [B, S, V]
+    helper = LayerHelper("gather_token_logits")
+    last = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("gather_token_logits",
+                     {"X": [logits], "Lens": [lens]}, {"Out": [last]}, {})
+    return {"feeds": [TOK_FEED, POS_FEED, PAGES_FEED, START_FEED, LEN_FEED],
+            "next_token": _greedy(last),
+            "last_logits": last,
+            "tokens": L.argmax(logits, axis=2),
+            "logits": logits}
+
+
+def build_cow_program(cfg: DecoderConfig, num_pages: int, page_size: int):
+    """Build (in the current default main program) the copy-on-write step:
+    one `kv_cache_copy_page` per layer — pool[Dst] := pool[Src] for K and V,
+    in place. Feeds sv_cow_src/sv_cow_dst [1] int32; fetches nothing (the
+    pools are the output, via the donation contract). Compiled exactly once
+    per engine — COW cost is one tiny device step, not a recompile."""
+    src = L.data(name=COW_SRC_FEED, shape=[], dtype="int32")
+    dst = L.data(name=COW_DST_FEED, shape=[], dtype="int32")
+    declare_pool_vars(default_main_program().global_block, cfg.num_layers,
+                      num_pages, page_size, cfg.num_heads, cfg.head_dim,
+                      cfg.dtype)
+    for kn, vn in pool_var_names(cfg.num_layers):
+        helper = LayerHelper("kv_cache_copy_page")
+        helper.append_op(
+            "kv_cache_copy_page",
+            {"KPool": [kn], "VPool": [vn], "Src": [src], "Dst": [dst]},
+            {"KPoolOut": [kn], "VPoolOut": [vn]}, {})
+    return {"feeds": [COW_SRC_FEED, COW_DST_FEED]}
+
+
+def build_decode_program(cfg: DecoderConfig, num_pages: int, page_size: int,
+                         tp: int = 1):
     """Build (in the current default main program) the ragged decode step.
 
     Feeds: sv_tok [B, 1] int32 (each row's latest token), sv_pos [B] int32
@@ -209,7 +309,7 @@ def build_decode_program(cfg: DecoderConfig, num_pages: int, page_size: int):
             "paged_decode_attention",
             {"Q": [q], "KPool": [kn], "VPool": [vn],
              "PageTable": [pages], "Positions": [pos]},
-            {"Out": [att]}, {"sm_scale": dh ** -0.5})
+            {"Out": [att]}, {"sm_scale": dh ** -0.5, "tp_degree": tp})
         a = _proj(L.reshape(att, shape=[0, 1, cfg.hidden_size]),
                   cfg.hidden_size, name + ".mha.out")
         x = _ln(L.elementwise_add(x, a), name + ".ln1")
@@ -217,7 +317,48 @@ def build_decode_program(cfg: DecoderConfig, num_pages: int, page_size: int):
     logits = L.squeeze(_head(x, cfg), axes=[1])        # [B, V]
     nxt = _greedy(logits)
     return {"feeds": [TOK_FEED, POS_FEED, PAGES_FEED, MASK_FEED],
-            "next_token": nxt}
+            "next_token": nxt, "logits": logits}
+
+
+# per-dim mesh-axis layout of the decoder's TP-sharded parameters
+# (Megatron-style: qkv/ffn-in split their OUTPUT features, the projections
+# back to hidden split their INPUT features so the row-parallel matmul's
+# psum is the only collective per block). GSPMD treats these as layout
+# hints, never correctness: an unannotated or oddly-divisible tensor simply
+# replicates.
+_TP_PARAM_LAYOUT = [
+    (".mha.qkv.w", (None, "{tp}")), (".mha.qkv.b", ("{tp}",)),
+    (".mha.out.w", ("{tp}", None)),
+    (".ffn.in.w", (None, "{tp}")), (".ffn.in.b", ("{tp}",)),
+    (".ffn.out.w", ("{tp}", None)),
+]
+
+
+def apply_tp_annotations(program, cfg: DecoderConfig, tp: int) -> int:
+    """Annotate a built serving program's vars for tensor parallelism over
+    the `tp` mesh axis (parallel/mesh.MODEL_AXIS): attention/FFN weights
+    per _TP_PARAM_LAYOUT and the KV pool vars on their heads dim — the
+    layout "Ragged Paged Attention" (arXiv:2604.15464) head-sharded decode
+    assumes. Returns how many vars were annotated. Dims that `tp` does not
+    divide are left replicated (GSPMD stays correct either way)."""
+    from ..parallel.mesh import MODEL_AXIS
+    from ..parallel.sharding import annotate_sharding
+
+    done = 0
+    block = program.global_block
+    for name, var in block.vars.items():
+        for suffix, spec in _TP_PARAM_LAYOUT:
+            if not name.endswith(suffix):
+                continue
+            axes = tuple(MODEL_AXIS if a == "{tp}" else a for a in spec)
+            if all(a is None or (var.shape[d] % tp == 0)
+                   for d, a in enumerate(axes)):
+                annotate_sharding(var, axes)
+                done += 1
+        if name.startswith("kv_cache.") and cfg.num_heads % tp == 0:
+            annotate_sharding(var, (None, None, MODEL_AXIS, None))
+            done += 1
+    return done
 
 
 def build_full_forward_program(cfg: DecoderConfig):

@@ -1,0 +1,81 @@
+"""Sampling suite for the serving engine: temperature / top-k / top-p.
+
+Sampling runs on the HOST over the decode step's fetched logits row, not
+inside the compiled graph, for one load-bearing reason: determinism across
+batch-bucket recompiles. An in-graph PRNG would key off the padded batch
+shape, so the same request would draw different tokens depending on who it
+happened to be batched with. Here every (engine seed, request id, token
+index) triple owns its own numpy Generator stream, so a request's token
+sequence is a pure function of its own identity — replayable across runs,
+engine restarts, and whatever bucket the scheduler packed it into.
+
+Greedy (temperature <= 0) stays the engine's compiled argmax path; sampling
+requests read the same step's `logits` fetch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["SamplingParams", "sample_token", "request_rng"]
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode distribution controls.
+
+    temperature <= 0 means greedy (argmax; the speculative-decode fast
+    path). top_k <= 0 disables the top-k filter; top_p >= 1 disables the
+    nucleus filter. Filters compose in the standard order:
+    logits/temperature -> top-k -> top-p -> renormalize -> sample.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{self.temperature}")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def request_rng(seed: int, rid: int, token_index: int) -> np.random.Generator:
+    """The deterministic per-token stream: distinct (seed, rid, index)
+    triples give independent streams, identical triples identical draws —
+    the whole determinism contract in one constructor."""
+    return np.random.default_rng(
+        np.random.SeedSequence((int(seed), int(rid), int(token_index))))
+
+
+def sample_token(logits, params: SamplingParams,
+                 rng: np.random.Generator) -> int:
+    """Draw one token id from a [V] logits row under `params`."""
+    logits = np.asarray(logits, np.float64).reshape(-1)
+    if params.is_greedy:
+        return int(np.argmax(logits))
+    z = logits / max(params.temperature, 1e-6)
+    if params.top_k and params.top_k < z.size:
+        kth = np.partition(z, -params.top_k)[-params.top_k]
+        z = np.where(z >= kth, z, -np.inf)
+    # softmax in float64 (host-side; V rows are small next to the model)
+    z = z - z.max()
+    probs = np.exp(z)
+    probs /= probs.sum()
+    if params.top_p < 1.0:
+        order = np.argsort(-probs, kind="stable")
+        csum = np.cumsum(probs[order])
+        # smallest prefix whose mass reaches top_p (always >= 1 token)
+        cut = int(np.searchsorted(csum, params.top_p)) + 1
+        keep = order[:cut]
+        mask = np.zeros_like(probs)
+        mask[keep] = probs[keep]
+        probs = mask / mask.sum()
+    return int(rng.choice(probs.size, p=probs))

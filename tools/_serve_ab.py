@@ -1,4 +1,4 @@
-"""Open-loop served-load driver for the serving runtime (ISSUE 7).
+"""Open-loop served-load driver for the serving runtime (ISSUE 7 + 11).
 
 Open-loop means arrivals do NOT wait for the system: request i arrives at
 its scheduled offset (exponential inter-arrival at `rate` req/s) whether or
@@ -8,8 +8,17 @@ queueing collapse). Per-request stamps (arrival, first token, completion)
 feed the shared tools/_timing.py percentile protocol, so p50/p99 here and
 in the bench.py `serving` block are the same arithmetic.
 
+ISSUE 11 adds the multi-tenant workload: `--shared-prefix` draws each
+request's system prompt zipf-distributed from a small set (the
+many-users-few-templates shape of production traffic), runs the sweep at
+10x the r8 request rates, and `--ab` interleaves a PR 7-equivalent
+baseline arm (prefix cache off, no speculation) over the SAME seeded
+arrival trace — served tok/s up + prefill-tokens-computed down is the
+acceptance bar, printed per rate.
+
     python tools/_serve_ab.py                       # default rate sweep
     python tools/_serve_ab.py --rates 4,16,64 --requests 64
+    python tools/_serve_ab.py --shared-prefix --ab  # the ISSUE 11 verdict
     python tools/_serve_ab.py --pool-pages 64       # pressure the pool
 
 Each rate prints one JSON line; the last line is the sweep summary.
@@ -46,10 +55,36 @@ def synth_workload(n_requests: int, vocab_size: int, seed: int,
     return out
 
 
-def run_open_loop(engine, workload, max_steps: int = 200_000) -> dict:
-    """Drive one engine through one workload; returns the serving metrics
-    block (served tokens/s, p50/p99 request + first-token latency, pool
-    occupancy, and the zero-leak page count)."""
+def synth_shared_prefix_workload(n_requests: int, vocab_size: int, seed: int,
+                                 n_sys_prompts: int = 8, sys_len: int = 16,
+                                 user_lens=(2, 8), max_new: int = 8,
+                                 rate: float = 8.0,
+                                 zipf_a: float = 1.2) -> list:
+    """The multi-tenant mix: every request = one of `n_sys_prompts` shared
+    system prompts (zipf-ranked — a few templates carry most traffic, the
+    tail stays cold) + a short unique user suffix. Seeded like
+    synth_workload, so the prefix-cache arm and the baseline arm replay the
+    IDENTICAL arrival trace."""
+    rng = np.random.default_rng(seed)
+    sys_prompts = [rng.integers(1, vocab_size, sys_len).tolist()
+                   for _ in range(n_sys_prompts)]
+    ranks = np.arange(1, n_sys_prompts + 1, dtype=np.float64) ** -zipf_a
+    probs = ranks / ranks.sum()
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests))
+    lo, hi = user_lens
+    out = []
+    for i in range(n_requests):
+        which = int(rng.choice(n_sys_prompts, p=probs))
+        suffix = rng.integers(1, vocab_size,
+                              int(rng.integers(lo, hi + 1))).tolist()
+        out.append((float(arrivals[i]), sys_prompts[which] + suffix,
+                    int(max_new)))
+    return out
+
+
+def _drive(engine, workload, max_steps: int):
+    """Replay one seeded arrival trace through the engine; returns the
+    measured pass's request ids and wall time."""
     pending = deque(sorted(workload))
     rids = []
     t0 = time.perf_counter()
@@ -67,7 +102,49 @@ def run_open_loop(engine, workload, max_steps: int = 200_000) -> dict:
         if steps > max_steps:
             raise RuntimeError(f"open loop did not drain in {max_steps} "
                                f"iterations")
-    wall = time.perf_counter() - t0
+    return rids, time.perf_counter() - t0
+
+
+def run_open_loop(engine, workload, max_steps: int = 200_000,
+                  warmup: bool = False) -> dict:
+    """Drive one engine through one workload; returns the serving metrics
+    block (served tokens/s, p50/p99 request + first-token latency, pool
+    occupancy, prefix-cache + speculative-decode counters, and the
+    zero-leak page/refcount accounting).
+
+    warmup=True measures the COMPILE-FREE steady state: the trace replays
+    (up to 4 passes) until one pass triggers zero fresh XLA compiles — a
+    single stray sub-second CPU compile inside a sub-second measured pass
+    otherwise decides the verdict, not the engines. Queue dynamics shift
+    batch-bucket signatures between passes, so one discarded pass is not
+    enough; the jit_compile_counter hook (PR 2) says when the cache is
+    actually saturated. The prefix cache stays warm across passes — the
+    sustained-serving regime a production engine lives in, and the only one
+    where arms with different compile footprints compare honestly."""
+    from paddle_tpu.pipeline import jit_compile_counter
+
+    passes = 8 if warmup else 1
+    n_compiles = 0
+    clean_streak = 0
+    if warmup:
+        # the decode (batch, pages) signature a step hits is load-timing
+        # dependent — precompile the whole lattice so no pass can get a
+        # stray XLA compile from an unluckily-deep (or -shallow) queue
+        engine.warmup_decode(max(len(p) + mn for _, p, mn in workload))
+    for att in range(passes):
+        with jit_compile_counter() as compiles:
+            rids, wall = _drive(engine, workload, max_steps)
+        n_compiles = compiles.count
+        if not warmup:
+            break
+        # accept the SECOND consecutive compile-free pass: the first one
+        # still pays for the compile passes' side effects (allocator and
+        # dispatch caches, OS frequency state) and reads 2-5x slow
+        clean_streak = clean_streak + 1 if n_compiles == 0 else 0
+        if clean_streak >= 2:
+            break
+        if att < passes - 1:
+            engine.reset_stats()
 
     reqs = [engine.requests[r] for r in rids]
     done = [r for r in reqs if r.state == "finished"]
@@ -78,6 +155,11 @@ def run_open_loop(engine, workload, max_steps: int = 200_000) -> dict:
     st = engine.stats
     occ_mean = (st["occupancy_sum"] / st["occupancy_n"]
                 if st["occupancy_n"] else 0.0)
+    leaked = engine.leaked_pages()
+    engine.flush_prefix_cache()
+    # after drain + flush only a refcount bug can keep pages off-list
+    refcount_leaks = engine.pool.num_pages - engine.pool.free_count
+    prefix_total = st["prefix_hit_tokens"] + st["prefill_tokens_computed"]
     return {
         "requests": len(reqs),
         "finished": len(done),
@@ -90,24 +172,73 @@ def run_open_loop(engine, workload, max_steps: int = 200_000) -> dict:
         "kv_pool_occupancy_mean": round(occ_mean, 4),
         "kv_pool_occupancy_peak": round(
             st["peak_pages_in_use"] / engine.pool.num_pages, 4),
-        "kv_pages_leaked": engine.pool.num_pages - engine.pool.free_count,
+        "kv_pages_leaked": leaked,
+        "refcount_leaks": refcount_leaks,
         "decode_steps": st["decode_steps"],
         "prefills": st["prefills"],
         "preemptions": st["preemptions"],
         "decode_compile_buckets": len(st["decode_signatures"]),
         "prefill_compile_buckets": len(st["prefill_signatures"]),
+        "measured_pass_compiles": n_compiles,
+        # prefix caching (ISSUE 11): how much prefill the cache absorbed
+        "prefill_tokens_computed": st["prefill_tokens_computed"],
+        "prefix_hit_tokens": st["prefix_hit_tokens"],
+        "prefix_cache_hit_rate": round(
+            st["prefix_hit_tokens"] / prefix_total, 4) if prefix_total else 0.0,
+        "prefix_full_hits": st["prefix_full_hits"],
+        "cow_copies": st["cow_copies"],
+        # speculative decoding (ISSUE 11): accepted-token rate
+        "spec_steps": st["spec_steps"],
+        "spec_accept_rate": round(
+            st["spec_accepted"] / st["spec_proposed"], 4)
+        if st["spec_proposed"] else 0.0,
+        "tokens_per_decode_step": round(
+            st["decode_tokens"] / st["decode_steps"], 3)
+        if st["decode_steps"] else 0.0,
     }
 
 
-def main():
-    from paddle_tpu.serving import DecoderConfig, ServingEngine, decoder_tiny
+def ab_config(on_tpu: bool, shared_prefix: bool):
+    """(cfg, prompt_lens, user_lens) for the sweep. The shared-prefix CPU
+    config is deliberately LESS tiny than decoder_tiny: at decoder_tiny
+    scale every program costs ~0.5 ms of dispatch regardless of tokens, so
+    prefill savings are invisible — this config makes the 128-token-bucket
+    classic prefill ~2.4x the cost of the 8-token suffix window, which is
+    the (much starker) shape of the TPU regime."""
+    from paddle_tpu.serving import DecoderConfig, decoder_tiny
 
+    if on_tpu:
+        cfg = DecoderConfig(vocab_size=30522, hidden_size=512, num_layers=6,
+                            num_heads=8, ffn_size=2048, max_position=1024)
+        return cfg, (16, 128), (8, 64)
+    if shared_prefix:
+        cfg = DecoderConfig(vocab_size=997, hidden_size=64, num_layers=3,
+                            num_heads=4, ffn_size=256, max_position=256)
+        return cfg, (4, 24), (2, 8)
+    return decoder_tiny(), (4, 24), (2, 8)
+
+
+def _mk_engine(cfg, args, prefix_cache=None, draft_k=None):
+    from paddle_tpu.serving import ServingEngine
+
+    return ServingEngine(
+        cfg, page_size=args.page_size, pool_pages=args.pool_pages,
+        max_inflight=args.max_inflight, policy=args.policy, seed=args.seed,
+        prefix_cache=(args.prefix_cache if prefix_cache is None
+                      else prefix_cache),
+        draft_k=(args.draft_k if draft_k is None else draft_k),
+        tp=args.tp)
+
+
+def main():
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rates", default="4,16,64" if on_tpu else "8,32",
-                    help="comma list of arrival rates (req/s)")
+    ap.add_argument("--rates", default=None,
+                    help="comma list of arrival rates (req/s); default "
+                         "4,16,64 TPU / 8,32 CPU, 10x that with "
+                         "--shared-prefix")
     ap.add_argument("--requests", type=int, default=64 if on_tpu else 16)
     ap.add_argument("--max-new", type=int, default=32 if on_tpu else 6)
     ap.add_argument("--page-size", type=int, default=None)
@@ -115,27 +246,78 @@ def main():
     ap.add_argument("--max-inflight", type=int, default=None)
     ap.add_argument("--policy", default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="zipf-distributed system-prompt reuse mix at 10x "
+                         "rates (the ISSUE 11 workload)")
+    ap.add_argument("--sys-prompts", type=int, default=8)
+    ap.add_argument("--sys-len", type=int, default=None,
+                    help="shared system-prompt length (default: 8 pages "
+                         "TPU / 6 pages CPU)")
+    ap.add_argument("--zipf", type=float, default=1.2)
+    ap.add_argument("--prefix-cache", type=int, default=None,
+                    help="1/0 force the prefix cache (default: flag)")
+    ap.add_argument("--draft-k", type=int, default=None,
+                    help="speculative draft length (default: flag)")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel degree (default: flag)")
+    ap.add_argument("--ab", action="store_true",
+                    help="also run the PR 7 baseline arm (prefix cache "
+                         "off, draft 0) on the same trace and print the "
+                         "comparison")
     args = ap.parse_args()
+    if args.prefix_cache is not None:
+        args.prefix_cache = bool(args.prefix_cache)
 
-    if on_tpu:
-        cfg = DecoderConfig(vocab_size=30522, hidden_size=512, num_layers=6,
-                            num_heads=8, ffn_size=2048, max_position=1024)
-        prompt_lens = (16, 128)
-    else:
-        cfg = decoder_tiny()
-        prompt_lens = (4, 24)
+    cfg, prompt_lens, user_lens = ab_config(on_tpu, args.shared_prefix)
+
+    base_rates = "4,16,64" if on_tpu else "8,32"
+    if args.rates is None:
+        # ISSUE 11: the shared-prefix sweep runs at 10x the r8 rates
+        args.rates = (",".join(str(10 * float(r))
+                               for r in base_rates.split(","))
+                      if args.shared_prefix else base_rates)
+    import paddle_tpu as pt
+
+    ps = args.page_size or int(pt.flags.get_flag("serving_page_size"))
+    # whole pages (page-granular sharing) and comfortably under max_position
+    sys_len = (args.sys_len if args.sys_len is not None
+               else (8 * ps if on_tpu else 6 * ps))
 
     summary = {}
     for rate in [float(r) for r in args.rates.split(",") if r]:
-        engine = ServingEngine(cfg, page_size=args.page_size,
-                               pool_pages=args.pool_pages,
-                               max_inflight=args.max_inflight,
-                               policy=args.policy, seed=args.seed)
-        wl = synth_workload(args.requests, cfg.vocab_size, args.seed,
-                            prompt_lens=prompt_lens, max_new=args.max_new,
-                            rate=rate)
-        out = run_open_loop(engine, wl)
+        if args.shared_prefix:
+            wl = synth_shared_prefix_workload(
+                args.requests, cfg.vocab_size, args.seed,
+                n_sys_prompts=args.sys_prompts, sys_len=sys_len,
+                user_lens=user_lens, max_new=args.max_new, rate=rate,
+                zipf_a=args.zipf)
+        else:
+            wl = synth_workload(args.requests, cfg.vocab_size, args.seed,
+                                prompt_lens=prompt_lens,
+                                max_new=args.max_new, rate=rate)
+        # steady-state measurement under --ab/--shared-prefix: both arms
+        # pre-warm compiles + cache on one discarded pass of the trace
+        warm = args.ab or args.shared_prefix
+        out = run_open_loop(_mk_engine(cfg, args), wl, warmup=warm)
         out["rate_req_s"] = rate
+        out["warmup"] = warm
+        if args.ab:
+            base = run_open_loop(
+                _mk_engine(cfg, args, prefix_cache=False, draft_k=0), wl,
+                warmup=warm)
+            out["baseline"] = {
+                "served_tokens_per_sec": base["served_tokens_per_sec"],
+                "prefill_tokens_computed": base["prefill_tokens_computed"],
+                "request_latency": base["request_latency"],
+                "kv_pages_leaked": base["kv_pages_leaked"],
+                "refcount_leaks": base["refcount_leaks"],
+            }
+            out["vs_baseline_tok_s"] = round(
+                out["served_tokens_per_sec"]
+                / max(base["served_tokens_per_sec"], 1e-9), 3)
+            out["prefill_tokens_saved"] = (
+                base["prefill_tokens_computed"]
+                - out["prefill_tokens_computed"])
         print(json.dumps(out), flush=True)
         summary[str(rate)] = out["served_tokens_per_sec"]
     print(json.dumps({"sweep": "serve_ab", "served_tok_s_by_rate": summary}),

@@ -57,6 +57,14 @@ TUNER_HIT_RATE_FLOOR = 0.5
 # a hard fail at any count: the pool never reclaims them.
 SERVING_TOK_S_DROP = 0.8
 
+# multi-tenant serving (ISSUE 11): when the shared-prefix mix runs, the
+# prefix cache must actually be absorbing prefill — a hit rate below this
+# floor on the zipf system-prompt workload means the page-granular index
+# is broken (mis-keyed blocks, over-eager eviction), since the workload is
+# built to reuse 8 templates. Refcount leaks (pages still off the free
+# list after drain + cache flush) are a hard fail at any count in ANY arm.
+PREFIX_HIT_RATE_FLOOR = 0.5
+
 # tiered embedding engine (ISSUE 10): parameter parity vs the dense-lookup
 # oracle is a hard correctness invariant — the tiered path is a data-movement
 # refactor, any drift beyond float associativity means a lost update
@@ -282,6 +290,42 @@ def _check_tuner_coverage(data: dict, label: str) -> int:
     return rc
 
 
+def _check_shared_prefix(sv: dict, label: str) -> int:
+    """Multi-tenant serving gate (ISSUE 11): over the shared-prefix zipf
+    mix, refcount/page leaks hard-fail in EVERY arm (an abort path that
+    frees a page another request still maps corrupts silently — the leak
+    counter is the only cheap tripwire), and the prefix-cache arm's hit
+    rate must clear PREFIX_HIT_RATE_FLOOR."""
+    sp = sv.get("shared_prefix")
+    if not isinstance(sp, dict):
+        return 0
+    rc = 0
+    arms = sp.get("arms") or {}
+    for arm, row in arms.items():
+        for field in ("kv_pages_leaked", "refcount_leaks"):
+            n = row.get(field)
+            if n:
+                print(f"[gate] FAIL: shared-prefix arm '{arm}' reports "
+                      f"{field}={n} — a refcount path (share/release/COW/"
+                      f"evict) is freeing or orphaning pages it must not",
+                      flush=True)
+                rc = 1
+    hit = (arms.get("prefix") or {}).get("prefix_cache_hit_rate")
+    spec = (arms.get("prefix_spec") or {}).get("spec_accept_rate")
+    print(f"[gate] bench {label}: shared-prefix vs_baseline "
+          f"{sp.get('vs_baseline_tok_s')}x tok/s, prefill tokens saved "
+          f"{sp.get('prefill_tokens_saved')}, hit rate {hit}, "
+          f"spec accept {spec}", flush=True)
+    if hit is not None and hit < PREFIX_HIT_RATE_FLOOR:
+        print(f"[gate] FAIL: prefix-cache hit rate {hit} < "
+              f"{PREFIX_HIT_RATE_FLOOR} on the zipf shared-prefix mix — "
+              f"the page-granular index is not matching the templates it "
+              f"was built to share (key drift or over-eager eviction)",
+              flush=True)
+        rc = 1
+    return rc
+
+
 def _check_serving(data: dict, prev_path: str | None, label: str) -> int:
     """Serving-block gate (ISSUE 7): zero KV-page leak is a hard invariant;
     served tokens/s may not drop below SERVING_TOK_S_DROP of the previous
@@ -303,6 +347,15 @@ def _check_serving(data: dict, prev_path: str | None, label: str) -> int:
               f"preempt) is not returning pages to the free list",
               flush=True)
         return 1
+    if sv.get("refcount_leaks"):
+        print(f"[gate] FAIL: {sv['refcount_leaks']} pages still off the "
+              f"free list after drain + prefix-cache flush — a refcount "
+              f"path (share/release/COW/evict) lost track of a holder",
+              flush=True)
+        return 1
+    rc = _check_shared_prefix(sv, label)
+    if rc:
+        return rc
     if cur is None or prev_path is None:
         return 0
     try:

@@ -95,6 +95,14 @@ DECODE_ATTENTION_SHAPES = [
     ("decode_b64_kv2048", 64, 12, 2048, 64),
 ]
 
+# TP-sharded serving decode (ISSUE 11): under GSPMD each tp shard executes
+# nh/tp heads of the same decode shape, and paged_attention_backend keys the
+# DB on that PER-SHARD shape. The per-shard shapes are recorded as
+# `candidate` entries so `--what candidates` measures and upgrades them
+# exactly like the PR 7 decode regimes — TP decode resolves through the DB
+# like every other lever.
+SERVING_TP_DEGREES = (2, 4)
+
 
 # the epilogue lever's sweep set (ISSUE 9): the BN apply tail of the
 # PERF.md r6 cost-table conv OUTPUT shapes — (name, batch, channels,
@@ -276,6 +284,37 @@ def sweep_attention(db, shapes, dtype: str, iters: int, passes: int,
                note=f"{name}: verdict={verdict}")
         print(json.dumps({"shape": name, "decision": backend,
                           "verdict": verdict}), flush=True)
+
+
+def record_tp_decode_candidates(db, shapes, dtype: str,
+                                tp_degrees=SERVING_TP_DEGREES) -> int:
+    """Record the head-sharded decode shapes (nh/tp per shard) as
+    `candidate` DB entries. Candidates never clobber swept verdicts and
+    never count as hits (the PR 6 contract); `sweep_candidates` routes the
+    sq=1 family through `sweep_decode_attention` and upgrades them to
+    swept verdicts — after which a TP serving engine's per-shard dispatch
+    is a DB hit like any other lever's."""
+    from paddle_tpu import flags as pt_flags
+
+    key_dtype = str(jnp.dtype(dtype))
+    ps = int(pt_flags.get_flag("serving_page_size"))
+    added = 0
+    for _, b, nh, kv, dh in shapes:
+        kv = max(ps, (kv // ps) * ps)
+        for tp in tp_degrees:
+            if nh % tp or nh // tp < 1:
+                continue
+            key = tuning.canonical_key(
+                "attention", tuning.attention_key(b, nh // tp, 1, kv, dh,
+                                                  True),
+                key_dtype, tuning.device_kind())
+            if db.lookup(key) is not None:
+                continue
+            db.put(key, {"backend": "xla"}, source="candidate")
+            added += 1
+    print(json.dumps({"sweep": "tp_decode_candidates", "recorded": added,
+                      "tp_degrees": list(tp_degrees)}), flush=True)
+    return added
 
 
 def sweep_decode_attention(db, shapes, dtype: str, iters: int, passes: int,
@@ -733,6 +772,9 @@ def main():
         # op kind, same DB namespace, different (sq=1) shape family
         sweep_decode_attention(db, decode_shapes, args.dtype, args.iters,
                                args.passes, args.band)
+        # TP-sharded serving (ISSUE 11): per-shard (nh/tp) decode shapes
+        # land as candidates for `--what candidates` to measure
+        record_tp_decode_candidates(db, decode_shapes, args.dtype)
     if "epilogue" in what:
         sweep_epilogue(db, epi_bn_shapes, epi_ln_shapes, args.dtype,
                        args.iters, args.passes, args.band)
