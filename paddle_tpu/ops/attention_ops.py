@@ -205,6 +205,21 @@ def _pallas_paged_ok(q_shape, pool_shape) -> bool:
             and ppa.paged_supported(tuple(q_shape), tuple(pool_shape)))
 
 
+def _shard_paged_shapes(q_shape, pool_shape, tp=1):
+    """The PER-SHARD view of a paged decode shape under tp-way head
+    sharding: GSPMD hands each shard nh/tp heads of BOTH the query and the
+    pool, so the tuning key and every executability check must see the same
+    nh/tp shapes — a verdict decided at one head count and dispatched at
+    another is wrong in both directions."""
+    tp = max(1, int(tp))
+    B, nh, dh = q_shape
+    q = (B, max(1, int(nh) // tp), dh)
+    if pool_shape is None:
+        return q, None
+    num_pages, ps, p_nh, p_dh = pool_shape
+    return q, (num_pages, ps, max(1, int(p_nh) // tp), p_dh)
+
+
 def paged_attention_backend(batch, num_heads, kv_slots, head_dim, dtype,
                             pool_shape=None, tp=1):
     """Which kernel carries one ragged decode-attention shape (sq=1, sk =
@@ -222,7 +237,9 @@ def paged_attention_backend(batch, num_heads, kv_slots, head_dim, dtype,
     each tp shard executes nh/tp heads, so the DB key is the PER-SHARD
     shape — exactly what tools/tune.py's head-sharded decode sweep records.
     """
-    num_heads = max(1, int(num_heads) // max(1, int(tp)))
+    (batch, num_heads, head_dim), pool_shape = _shard_paged_shapes(
+        (batch, num_heads, head_dim), pool_shape, tp)
+
     def analytic():
         if pool_shape is not None and _pallas_paged_ok(
                 (batch, num_heads, head_dim), pool_shape):
@@ -279,7 +296,10 @@ def paged_decode_attention_fn(q, k_pool, v_pool, page_table, kv_lens,
     P, ps = page_table.shape[1], k_pool.shape[1]
     backend, _tier = paged_attention_backend(B, nh, P * ps, dh, q.dtype,
                                              pool_shape=k_pool.shape, tp=tp)
-    if backend == "pallas_paged" and _pallas_paged_ok(q.shape, k_pool.shape):
+    # re-check executability at the SAME per-shard shapes the decision saw
+    # (under tp > 1 the global q/pool head counts are not what a shard runs)
+    shard_q, shard_pool = _shard_paged_shapes(q.shape, k_pool.shape, tp)
+    if backend == "pallas_paged" and _pallas_paged_ok(shard_q, shard_pool):
         from .pallas_kernels import paged_attention as ppa
 
         return ppa.paged_decode_attention(q, k_pool, v_pool, page_table,

@@ -376,6 +376,28 @@ class ServingEngine:
     def result(self, rid: int) -> list[int]:
         return list(self.requests[rid].out_tokens)
 
+    def pop_result(self, rid: int) -> list[int]:
+        """Return a FINISHED/ABORTED request's generated tokens and drop its
+        record. `requests` otherwise retains every completed request (full
+        token list included) for the engine's lifetime — unbounded growth
+        and ever-slower leak accounting under continuous serving."""
+        req = self.requests[rid]
+        if req.state not in (FINISHED, ABORTED):
+            raise ValueError(
+                f"request {rid} is {req.state}; only finished/aborted "
+                f"results can be popped")
+        del self.requests[rid]
+        return list(req.out_tokens)
+
+    def prune_finished(self) -> int:
+        """Drop every FINISHED/ABORTED request record (results the caller
+        has already read or will never read). Returns records dropped."""
+        done = [rid for rid, r in self.requests.items()
+                if r.state in (FINISHED, ABORTED)]
+        for rid in done:
+            del self.requests[rid]
+        return len(done)
+
     def leaked_pages(self) -> int:
         """Pages in use that NO live request and NO prefix-cache entry can
         account for — must be zero at every quiescent point."""
@@ -477,13 +499,20 @@ class ServingEngine:
                 self.stats["prefix_lookups"] += 1
                 matched = self.prefix_cache.match(
                     req.all_tokens[:req.prompt_len])
+                # pin the hit BEFORE allocating: the cache's own ref may be
+                # these pages' only holder, and _allocate's eviction relief
+                # under pool pressure could otherwise free the matched pages
+                # and hand them right back as this request's PRIVATE pages
+                # (one physical page mapped at two ordinals)
+                if matched:
+                    self.pool.share(matched)
             # +1: the decode step after prefill writes one more slot
             need = self.pool.pages_for(len(req.all_tokens) + 1)
             private = self._allocate(need - len(matched))
             if private is None:
+                if matched:
+                    self.pool.release(matched)
                 break
-            if matched:
-                self.pool.share(matched)
             req.pages = matched + private
             req.cached_len = len(matched) * self.page_size
             self.stats["prefix_hit_tokens"] += req.cached_len

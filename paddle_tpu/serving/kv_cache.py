@@ -29,6 +29,8 @@ corrupts.
 """
 from __future__ import annotations
 
+import heapq
+
 import jax.numpy as jnp
 
 __all__ = ["PagedKVPool", "PrefixCache", "pool_var_names",
@@ -191,7 +193,11 @@ class PrefixCache:
     Eviction is LRU over leaf nodes whose page nobody else holds
     (refcount 1 == the cache's own ref): evicting a shared page would free
     no HBM anyway, and an interior node can't go before its children or the
-    chain below it would dangle.
+    chain below it would dangle. The LRU order lives in a lazy min-heap of
+    (last_use, nid) stamps — every touch pushes a fresh stamp, pops discard
+    stale ones — so `evict(need)` is O((popped + need) log n) instead of a
+    full O(nodes) scan per freed page (a scheduler-thread stall at exactly
+    the pool-pressure moments eviction runs).
     """
 
     def __init__(self, pool: PagedKVPool):
@@ -199,6 +205,7 @@ class PrefixCache:
         self.page_size = pool.page_size
         self._nodes: dict[tuple, _PrefixNode] = {}
         self._by_id: dict[int, _PrefixNode] = {}
+        self._heap: list[tuple[int, int]] = []   # (last_use, nid), lazy
         self._next_id = 1
         self._clock = 0
         self.lookups = 0
@@ -209,6 +216,10 @@ class PrefixCache:
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
+
+    def _touch(self, node: _PrefixNode) -> None:
+        node.last_use = self._tick()
+        heapq.heappush(self._heap, (node.last_use, node.nid))
 
     @property
     def pages_held(self) -> int:
@@ -226,7 +237,7 @@ class PrefixCache:
             node = self._nodes.get((pid, block))
             if node is None:
                 break
-            node.last_use = self._tick()
+            self._touch(node)
             pages.append(node.page)
             pid = node.nid
         self.hit_pages += len(pages)
@@ -255,33 +266,48 @@ class PrefixCache:
                     self._by_id[pid].children += 1
                 added += 1
                 self.inserted_pages += 1
-            node.last_use = self._tick()
+            self._touch(node)
             pid = node.nid
         return added
-
-    def _evictable(self):
-        return (n for n in self._nodes.values()
-                if n.children == 0 and self.pool.refcount(n.page) == 1)
 
     def evict(self, need: int) -> int:
         """Release up to `need` pages back to the free list, LRU-first over
         evictable leaves. Returns pages actually freed (may be < need when
-        every remaining page is still mapped by a live request)."""
+        every remaining page is still mapped by a live request).
+
+        Pops the stamp heap: stale stamps (node gone, or re-touched since)
+        are discarded; stamps of nodes that are currently NOT evictable
+        (interior, or a live request still maps the page) are set aside and
+        reinserted afterwards, so a node that becomes evictable later —
+        its request released the page, or its children were dropped — is
+        still reachable through its standing stamp."""
         freed = 0
-        while freed < need:
-            victim = min(self._evictable(),
-                         key=lambda n: n.last_use, default=None)
-            if victim is None:
-                break
-            self._drop(victim)
+        skipped: list[tuple[int, int]] = []
+        while freed < need and self._heap:
+            stamp, nid = heapq.heappop(self._heap)
+            node = self._by_id.get(nid)
+            if node is None or node.last_use != stamp:
+                continue                     # stale: dropped or re-touched
+            if node.children or self.pool.refcount(node.page) != 1:
+                skipped.append((stamp, nid))
+                continue
+            self._drop(node)
             freed += 1
+        for entry in skipped:
+            heapq.heappush(self._heap, entry)
         return freed
 
     def _drop(self, node: _PrefixNode) -> None:
         del self._nodes[node.key]
         del self._by_id[node.nid]
         if node.parent_id:
-            self._by_id[node.parent_id].children -= 1
+            parent = self._by_id[node.parent_id]
+            parent.children -= 1
+            if parent.children == 0:
+                # the parent just became a leaf: restore its stamp so the
+                # SAME evict pass can cascade up the chain (its original
+                # stamp may sit in `skipped` until the pass ends)
+                heapq.heappush(self._heap, (parent.last_use, parent.nid))
         self.pool.release([node.page])
         self.evicted_pages += 1
 

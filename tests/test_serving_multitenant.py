@@ -159,6 +159,60 @@ def test_prefix_cache_evicts_under_pool_pressure():
     assert eng.leaked_pages() == 0
 
 
+def test_admit_pins_matched_prefix_pages_before_allocation():
+    """Pool-pressure admission with a prefix hit: _allocate's eviction
+    relief must never reclaim the pages match() just returned (the cache's
+    own ref may be their only holder). Without the share-before-allocate
+    pin, the evicted page comes straight back off the LIFO free list as one
+    of the SAME request's private pages — one physical page mapped at two
+    ordinals, silent KV corruption."""
+    cfg = decoder_tiny()
+    rng = np.random.default_rng(17)
+    hot = list(rng.integers(1, cfg.vocab_size, 8))    # kept running
+    cold = list(rng.integers(1, cfg.vocab_size, 8))   # cached, idle
+    tail = list(rng.integers(1, cfg.vocab_size, 12))
+
+    def run(prefix_cache):
+        eng = ServingEngine(cfg, page_size=4, pool_pages=8, max_inflight=4,
+                            prefix_cache=prefix_cache)
+        r1 = eng.submit(hot, max_new_tokens=8)
+        eng.step()
+        r2 = eng.submit(cold, max_new_tokens=1)
+        steps = 0
+        while eng.requests[r2].state != "finished":
+            eng.step()
+            steps += 1
+            assert steps < 100
+        # let r1 grow to its 4th page: free pages drop to 2, so admitting
+        # cold+tail (6 pages, 2 matched) must reclaim BOTH matched pages
+        # through the eviction-relief path
+        while len(eng.requests[r1].pages) < 4:
+            eng.step()
+            steps += 1
+            assert steps < 100
+        # cold's prompt pages sit in the cache at refcount 1 (the only
+        # evictable entries — hot's pages are pinned by the running r1);
+        # without the pin, eviction frees them and the LIFO free list hands
+        # one back inside a prefill-written ordinal of the same request
+        r3 = eng.submit(cold + tail, max_new_tokens=4)
+        while eng.has_work():
+            eng.step()
+            steps += 1
+            assert steps < 200
+            for r in eng.requests.values():
+                assert len(set(r.pages)) == len(r.pages), (
+                    f"request {r.rid} maps a physical page at two "
+                    f"ordinals: {r.pages}")
+            assert eng.leaked_pages() == 0
+        return eng, [eng.result(r) for r in (r1, r2, r3)]
+
+    _, want = run(prefix_cache=False)
+    eng, got = run(prefix_cache=True)
+    assert got == want, "pressure admission diverged from the cache-off run"
+    eng.flush_prefix_cache()
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
 # -- speculative decoding -----------------------------------------------------
 
 def test_ngram_draft_proposes_history_continuation():

@@ -145,6 +145,9 @@ def run_open_loop(engine, workload, max_steps: int = 200_000,
             break
         if att < passes - 1:
             engine.reset_stats()
+            # discarded pass: drop its request records (their stamps are
+            # never read) so repeated warmup passes don't grow the engine
+            engine.prune_finished()
 
     reqs = [engine.requests[r] for r in rids]
     done = [r for r in reqs if r.state == "finished"]
