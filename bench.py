@@ -840,6 +840,68 @@ def _bench_shared_prefix(on_tpu: bool):
     }
 
 
+def bench_telemetry(on_tpu: bool):
+    """Telemetry-layer overhead A/B (ISSUE 13): the SAME tiny device-path
+    async-dispatch step timed with FLAGS_obs_enable on vs off over the
+    shared `_timed_windows` protocol. The flag gates exactly what the
+    unified registry added over the PR 2 stage accumulators (histograms,
+    events, spans, exporter sinks) — counters/gauges stay on in both arms —
+    so the delta IS the layer's marginal cost on the hottest instrumented
+    loop (run_async dispatch + window drain + per-step latency histogram).
+    tools/gate.py --obs fails the artifact above 2%."""
+    import paddle_tpu as pt
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu import layers as L
+    from paddle_tpu.layers import tensor as T
+
+    rng = np.random.default_rng(13)
+    batch, dim = (4096, 256) if on_tpu else (256, 64)
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup), pt.unique_name.guard():
+        x = T.data(name="obs_x", shape=[dim], dtype="float32")
+        label = T.data(name="obs_y", shape=[1], dtype="float32")
+        h = L.fc(x, size=dim, act="relu")
+        logit = L.fc(h, size=1)
+        loss = L.mean(L.sigmoid_cross_entropy_with_logits(logit, label))
+        pt.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    feed = {"obs_x": jax.device_put(
+                rng.random((batch, dim), dtype=np.float32)),
+            "obs_y": jax.device_put(
+                rng.integers(0, 2, (batch, 1)).astype(np.float32))}
+    exe = pt.Executor()
+    iters, passes = (50, 3) if on_tpu else (20, 3)
+    steps_per_s = {}
+    old = pt_flags.get_flag("obs_enable")
+    try:
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            drain_name = main_p.all_parameters()[-1].name
+            exe.run(main_p, feed=feed)  # compile once; both arms share it
+            np.asarray(pt.global_scope().find_var(drain_name))
+
+            def run_once():
+                exe.run_async(main_p, feed=feed)
+
+            def drain():
+                exe.wait()
+                return pt.global_scope().find_var(drain_name)
+
+            for arm, flag_val in (("off", False), ("on", True)):
+                pt_flags.set_flags({"obs_enable": flag_val})
+                windows = _timed_windows(run_once, drain, iters, passes)
+                steps_per_s[arm] = batch / min(windows)
+    finally:
+        pt_flags.set_flags({"obs_enable": old})
+    overhead_pct = max(0.0,
+                       (1.0 - steps_per_s["on"] / steps_per_s["off"]) * 100.0)
+    return {
+        "obs_overhead_pct": round(overhead_pct, 2),
+        "examples_per_sec_obs_on": round(steps_per_s["on"], 2),
+        "examples_per_sec_obs_off": round(steps_per_s["off"], 2),
+        "config": f"fc{dim}x2 b{batch} async-dispatch a/b",
+    }
+
+
 def _tuned(tuner_stats: dict, name: str, fn, *args):
     """Run one workload section with the autotuner's provenance counters
     scoped to it: every decision the build/trace makes (conv lowering,
@@ -915,6 +977,18 @@ def main():
     short_ab = _tuned(tuner_stats, "bert_s128_shortattn", bench_bert_short,
                       on_tpu)
     serving = _tuned(tuner_stats, "serving", bench_serving, on_tpu)
+    telemetry = bench_telemetry(on_tpu)
+
+    # the registry's end-of-run name inventory rides in the artifact:
+    # tools/gate.py --obs lints it against observability/schema.py, so a
+    # metric added without a declaration fails the gate, not a dashboard
+    from paddle_tpu import observability as obs
+
+    _snap = obs.snapshot()
+    telemetry["metric_names"] = sorted(
+        {obs.base_name(k) for sect in ("counters", "gauges", "histograms")
+         for k in _snap[sect]} | set(_snap["stages"]))
+    telemetry["undeclared_metrics"] = _snap["undeclared"]
 
     # Per-workload targets. MFU workloads: the 0.45 north star
     # (BASELINE.json). DeepFM has no published number, so the declared
@@ -988,6 +1062,11 @@ def main():
         # occupancy. tools/gate.py fails on leaked KV pages and on a
         # served-tokens/s drop below the floor vs the previous artifact
         "serving": serving,
+        # ISSUE 13: the unified telemetry layer's overhead A/B
+        # (FLAGS_obs_enable on vs off on the async dispatch loop) plus the
+        # registry's metric-name inventory; tools/gate.py --obs fails
+        # overhead > 2%, undeclared metric names, or schema drift
+        "telemetry": telemetry,
         # autotuner provenance (paddle_tpu/tuning/): per-workload decision
         # counts and swept-DB hit-rate. tools/gate.py flags a consult-mode
         # workload that resolved mostly off the DB (running untuned)

@@ -7,6 +7,7 @@ a new execution model: whole-block lowering to XLA via JAX, SPMD parallelism
 over jax.sharding meshes, and Pallas kernels for hot ops.
 """
 from . import flags  # noqa: F401  (first: other modules read flags at import)
+from . import observability  # noqa: F401  (before profiler: its shims use it)
 from . import core  # noqa: F401
 from . import ops  # noqa: F401
 from . import profiler  # noqa: F401

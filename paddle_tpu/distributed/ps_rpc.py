@@ -39,12 +39,15 @@ Liveness (the distributed hang defense):
     rejoin-grace window before it will shut down without them."""
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from multiprocessing.connection import Client, Listener
 from typing import Any
 
 import numpy as np
+
+logger = logging.getLogger("paddle_tpu.distributed.ps_rpc")
 
 
 def rpc_deadline_s() -> float:
@@ -575,10 +578,16 @@ class PServerRuntime:
             return
         self._evicted.discard(t)
         self._all_done_since = None
-        self.liveness_log.append({"event": "rejoin", "trainer": t,
-                                  "step": self._step, "via": how})
+        rec = {"event": "rejoin", "trainer": t,
+               "step": self._step, "via": how}
+        self.liveness_log.append(rec)
+        # the print is load-bearing (tests grep the server subprocess's
+        # stdout); the logger + registry carry the structured copies
         print(f"[ps_rpc] {self.endpoint}: trainer {t} rejoined via {how} "
               f"at step {self._step}", flush=True)
+        logger.info("trainer %d rejoined via %s at step %d", t, how,
+                    self._step, extra={"ps_liveness": rec})
+        self._note_liveness(rec, "ps.rejoins")
 
     def _evict_locked(self, t: int, idle_s: float, timeout_s: float) -> None:
         self._evicted.add(t)
@@ -586,12 +595,25 @@ class PServerRuntime:
         # survivors' average (_run_round rescales to the active count)
         for buf in self._grad_buf.values():
             buf.pop(t, None)
-        self.liveness_log.append({"event": "evict", "trainer": t,
-                                  "step": self._step,
-                                  "idle_s": round(idle_s, 3)})
+        rec = {"event": "evict", "trainer": t, "step": self._step,
+               "idle_s": round(idle_s, 3)}
+        self.liveness_log.append(rec)
         print(f"[ps_rpc] {self.endpoint}: evicted trainer {t} from the "
               f"sync barrier at step {self._step} (no liveness signal for "
               f"{idle_s:.2f}s > {timeout_s:.2f}s deadline)", flush=True)
+        logger.warning("evicted trainer %d at step %d (idle %.2fs > %.2fs)",
+                       t, self._step, idle_s, timeout_s,
+                       extra={"ps_liveness": rec})
+        self._note_liveness(rec, "ps.evictions")
+
+    def _note_liveness(self, rec: dict, counter: str) -> None:
+        try:
+            from .. import observability as obs
+
+            obs.counter_inc(counter)
+            obs.event("ps.liveness", rec, level="warning")
+        except Exception:  # noqa: BLE001 — telemetry never stalls the server
+            pass
 
     def _maybe_release_barrier_locked(self) -> bool:
         """Run the round and release every waiting trainer once the posted
@@ -650,6 +672,12 @@ class PServerRuntime:
                               f"trainer(s) {sorted(self._evicted)} never "
                               f"rejoined within the grace window — "
                               f"shutting down", flush=True)
+                        logger.warning(
+                            "evicted trainer(s) %s never rejoined; shutting "
+                            "down", sorted(self._evicted),
+                            extra={"ps_liveness": {
+                                "event": "grace_shutdown",
+                                "evicted": sorted(self._evicted)}})
                         shutdown = True
                 else:
                     self._all_done_since = None

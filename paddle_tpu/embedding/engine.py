@@ -37,6 +37,7 @@ import warnings
 import numpy as np
 
 from .. import flags, profiler
+from .. import observability as obs
 from .host_tier import HostShardedTable
 
 __all__ = ["TieredEmbeddingEngine", "TICKET_KEY"]
@@ -213,6 +214,10 @@ class TieredEmbeddingEngine:
             ts.stats["hit_ids"] += hit_occ
             ts.stats["miss_ids"] += int(counts[miss_idx].sum())
             ts.stats["batches"] += 1
+            obs.counter_inc("emb.hit_ids", hit_occ,
+                            labels={"table": ts.name})
+            obs.counter_inc("emb.miss_ids", int(counts[miss_idx].sum()),
+                            labels={"table": ts.name})
 
             # victims for misses beyond the free list: lowest frequency
             # first, LRU tie-break; slots referenced THIS batch are pinned
@@ -245,6 +250,8 @@ class TieredEmbeddingEngine:
                     evict_pairs.append((j, old))
                     ts.pending_wb[old] = rec
                     ts.stats["evictions"] += 1
+                    obs.counter_inc("emb.evictions",
+                                    labels={"table": ts.name})
                 seen = ts.seen.get(uid, 0) + int(counts[i])
                 ts.seen[uid] = seen
                 ts.row2slot[uid] = slot
@@ -373,6 +380,8 @@ class TieredEmbeddingEngine:
                 with ts.lock:
                     ts.host.scatter(rows, arr[idxs])
                     ts.stats["writebacks"] += len(rows)
+                    obs.counter_inc("emb.writebacks", len(rows),
+                                    labels={"table": ts.name})
                     for r in rows:
                         if ts.pending_wb.get(r) is rec:
                             del ts.pending_wb[r]

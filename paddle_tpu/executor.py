@@ -25,6 +25,7 @@ the TPU-native equivalent of the reference's per-op seed attrs.
 from __future__ import annotations
 
 import collections
+import logging
 import time
 import warnings
 import weakref
@@ -35,12 +36,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import flags, profiler
+from . import observability as obs
 from .framework import OpError, Program, Variable, default_main_program
 from .ops.registry import ExecContext, get_op_def
 from .resilience.faults import fault_point
 from .resilience.guardrails import GUARD_HEALTH_NAME
 
 __all__ = ["Scope", "Executor", "global_scope", "scope_guard"]
+
+logger = logging.getLogger("paddle_tpu.executor")
 
 _SKIP_OPS = ("feed", "fetch")
 
@@ -557,7 +561,8 @@ class Executor:
                 self._step_guard.note_dispatch(self._dispatch_seq, feed)
             self._inflight.append(
                 (self._dispatch_seq, token, health,
-                 getattr(self, "_last_spmd_mode", "gspmd")))
+                 getattr(self, "_last_spmd_mode", "gspmd"),
+                 time.perf_counter()))
             window = int(flags.get_flag("max_inflight_steps"))
             if window > 0:
                 while len(self._inflight) > window:
@@ -584,7 +589,7 @@ class Executor:
         from .resilience.faults import InjectedFault, fault_point
         from .resilience.watchdog import Watchdog, runtime_state
 
-        step_id, token, health, spmd_mode = self._inflight[0]
+        step_id, token, health, spmd_mode, t_dispatch = self._inflight[0]
         stalled = False
         try:
             fault_point("pipeline_stall")
@@ -616,6 +621,12 @@ class Executor:
             wd.wait((lambda: False) if stalled else is_ready, state,
                     what=what)
         self._inflight.popleft()
+        # dispatch->completion latency: includes device queueing under the
+        # runahead window, which is the number the async loop actually
+        # experiences at each drain point
+        obs.counter_inc("train.steps")
+        obs.histogram_observe("train.step_latency_s",
+                              time.perf_counter() - t_dispatch)
         if health is not None and self._step_guard is not None:
             # token resolved above, so this 4-float read never blocks on
             # compute; observe() may raise GuardRewind (budget exhausted)
@@ -939,8 +950,14 @@ class Executor:
                     # dtype cast BEFORE dispatch (state untouched) — count
                     # it and keep the epoch alive
                     profiler.bump("feed.skip_corrupt")
+                    # the print is load-bearing (tests grep stdout); the
+                    # logger carries the structured copy
                     print(f"[executor] skipping corrupt batch "
                           f"(FLAGS_feed_skip_corrupt): {e}", flush=True)
+                    logger.warning(
+                        "skipping corrupt batch: %s", e,
+                        extra={"corrupt_batch": {"batch": n_batches + 1,
+                                                 "error": str(e)}})
                     continue
                 n_batches += 1
                 if n_batches == 1:
@@ -956,8 +973,14 @@ class Executor:
                         for lbl, o in zip(labels, outs))
                     dt = time.perf_counter() - t0
                     rate = (n_batches - 1) / dt if dt > 0 else float("inf")
+                    if rate != float("inf"):
+                        obs.gauge_set("train.batches_per_sec", rate)
                     print(f"batch {n_batches} ({rate:.1f} batch/s) "
                           f"{msg}", flush=True)
+                    logger.info(
+                        "trainer progress batch=%d rate=%.1f", n_batches,
+                        rate, extra={"trainer_progress": {
+                            "batch": n_batches, "batches_per_sec": rate}})
         finally:
             # epoch boundary: drain the window so trained state is final
             # before the dataset's _finish_to_run hook (and so an exception

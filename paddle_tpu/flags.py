@@ -121,6 +121,49 @@ _define("cpu_deterministic", False,
         "reference flags.cc:98)")
 _define("profiler_dir", "/tmp/paddle_tpu_profile",
         "default trace output directory for profiler.profiler()")
+# unified telemetry layer (observability/: registry, exporters, spans, SLO)
+_define("obs_enable", True,
+        "the observability layer's histogram/event/span machinery "
+        "(observability/registry.py): ON records streaming-percentile "
+        "histograms, the structured event ring, and TraceAnnotation+JSONL "
+        "spans alongside every counter; OFF reduces the layer to the bare "
+        "counter/gauge/stage accumulators (exactly the pre-ISSUE-13 cost — "
+        "profiler.stage_counters() and the serving stats keep working "
+        "either way). bench.py measures the on-vs-off overhead on the "
+        "timed-window protocol; tools/gate.py --obs fails it above 2%")
+_define("obs_jsonl_dir", "",
+        "directory for the JSONL telemetry stream: when set, every event "
+        "and span record appends atomically to <dir>/obs.jsonl (rotated at "
+        "FLAGS_obs_jsonl_rotate_mb to obs.jsonl.1). Empty (default) "
+        "disables the stream; tools/obs.py tails/summarizes the file")
+_define("obs_jsonl_rotate_mb", 8.0,
+        "size trigger in MB for rotating the FLAGS_obs_jsonl_dir stream "
+        "(os.replace to <path>.1 — the live path always holds a complete "
+        "stream)")
+_define("obs_prometheus_path", "",
+        "when set, observability.export_prometheus() writes the registry "
+        "snapshot here in Prometheus text exposition format (atomic "
+        "temp+rename). Empty (default) disables the file export")
+_define("obs_http_port", 0,
+        "serve the live registry snapshot at http://127.0.0.1:<port>"
+        "/metrics (Prometheus text) from a stdlib daemon thread; "
+        "0 (default) disables the endpoint")
+_define("obs_max_events", 1024,
+        "capacity of the in-memory structured-event ring the registry "
+        "keeps for snapshot()['events'] (the JSONL stream is unbounded; "
+        "this only caps what a snapshot carries)")
+_define("obs_slo_p99_ms", 0.0,
+        "SLO monitor (observability/slo.py): warn/alert when the "
+        "serving.request_s p99 exceeds this many milliseconds over the "
+        "rolling window; <=0 (default) disables the latency rule")
+_define("obs_slo_min_hit_rate", 0.0,
+        "SLO monitor: warn/alert when the prefix-cache hit rate "
+        "(prefix_hit_tokens over all prefill tokens) falls below this "
+        "floor; <=0 (default) disables the rule")
+_define("obs_slo_max_leaked_pages", 0,
+        "SLO monitor: warn/alert when the serving.leaked_pages gauge "
+        "exceeds this count (default 0 — any leak breaches, matching the "
+        "gate's zero-leak invariant)")
 # multichip collective-overlap knobs (parallel/collective.py, sharding.py,
 # pipeline.py — the measured scaling campaign, see README "Multichip")
 _define("allreduce_bucket_mb", 4.0,

@@ -1,0 +1,125 @@
+"""The declared metric schema: every name the runtime is allowed to emit.
+
+One flat list, imported by the registry at construction and by
+tools/gate.py --obs at lint time. A metric recorded under a name that is
+not declared here still lands (post-mortems beat purity), but the registry
+tracks it in `snapshot()["undeclared"]` and the gate turns that into a
+hard failure — adding a counter is a schema act, not just a call site.
+
+Kinds:
+  stage     — the profiler.record_stage/bump accumulators ([events, seconds]
+              pairs; the PR 2 pipeline vocabulary, kept verbatim so every
+              legacy call site lands unchanged)
+  counter   — monotonically increasing value, optionally labeled
+  gauge     — last-set value (occupancy, rates)
+  histogram — streaming distribution with p50/p95/p99 (log-spaced buckets)
+  event     — structured record on the event ring / JSONL stream
+"""
+from __future__ import annotations
+
+STAGE, COUNTER, GAUGE, HISTOGRAM, EVENT = (
+    "stage", "counter", "gauge", "histogram", "event")
+
+# (name, kind, help, label keys)
+DECLARED: list[tuple] = [
+    # -- pipeline stage counters (profiler.record_stage / profiler.bump) ----
+    ("pipeline.host_ingest", STAGE,
+     "DeviceLoader producer: host batch materialization", ()),
+    ("pipeline.device_put", STAGE,
+     "host->device staging transfers (DeviceLoader / feed_placer)", ()),
+    ("pipeline.dispatch", STAGE,
+     "Executor compiled-step dispatch (host side of one async step)", ()),
+    ("pipeline.window_drain", STAGE,
+     "run_async window-boundary waits on the oldest completion token", ()),
+    ("feed.skip_corrupt", STAGE,
+     "corrupt records skipped under FLAGS_feed_skip_corrupt", ()),
+    ("emb.resolved_batches", STAGE,
+     "tiered-embedding batches resolved through the hot-ID cache", ()),
+    ("ps.nonfinite_drop", STAGE,
+     "non-finite gradient sends dropped by the pserver", ()),
+    ("comm.nonfinite_drop", STAGE,
+     "non-finite gradient sends dropped by the async communicator", ()),
+    # -- serving runtime (serving/engine.py) --------------------------------
+    ("serving.prefills", COUNTER, "prompt prefills executed", ()),
+    ("serving.decode_steps", COUNTER, "batched decode steps", ()),
+    ("serving.decode_tokens", COUNTER, "tokens accepted by decode", ()),
+    ("serving.preemptions", COUNTER,
+     "requests preempted back to the waiting queue", ()),
+    ("serving.aborts", COUNTER, "requests aborted", ()),
+    ("serving.prefill_tokens_computed", COUNTER,
+     "prompt tokens that actually ran through prefill compute", ()),
+    ("serving.prefix_hit_tokens", COUNTER,
+     "prompt tokens served from the prefix cache", ()),
+    ("serving.prefix_lookups", COUNTER, "prefix-cache lookups", ()),
+    ("serving.prefix_full_hits", COUNTER,
+     "prompts fully covered by cached pages (zero-prefill admits)", ()),
+    ("serving.cow_copies", COUNTER, "copy-on-write page copies", ()),
+    ("serving.spec_steps", COUNTER, "speculative draft-verify steps", ()),
+    ("serving.spec_proposed", COUNTER, "draft tokens proposed", ()),
+    ("serving.spec_accepted", COUNTER, "draft tokens accepted", ()),
+    ("serving.pages_in_use", GAUGE, "KV pool pages currently mapped", ()),
+    ("serving.pool_occupancy", GAUGE,
+     "KV pool occupancy fraction (pages_in_use / num_pages)", ()),
+    ("serving.leaked_pages", GAUGE,
+     "pages no live request or cache entry accounts for (must be 0)", ()),
+    ("serving.queue_s", HISTOGRAM,
+     "request queue time: submit -> admission", ()),
+    ("serving.ttft_s", HISTOGRAM,
+     "time to first token: submit -> first generated token", ()),
+    ("serving.request_s", HISTOGRAM,
+     "request latency: submit -> finished", ()),
+    ("serving.prefill.seconds", HISTOGRAM,
+     "prefill span durations (also a TraceAnnotation in XPlane)", ()),
+    ("serving.decode.seconds", HISTOGRAM,
+     "decode-step span durations (also a TraceAnnotation in XPlane)", ()),
+    ("serving.request", EVENT,
+     "per-request lifecycle record: queued/admitted/first_token/"
+     "finished/aborted", ("rid", "phase")),
+    # -- training step telemetry (executor.py async window) -----------------
+    ("train.steps", COUNTER, "async steps drained to completion", ()),
+    ("train.step_latency_s", HISTOGRAM,
+     "dispatch -> completion-token latency per drained step", ()),
+    ("train.batches_per_sec", GAUGE,
+     "train_from_dataset steady-state batch rate", ()),
+    ("train.jit_compiles", COUNTER,
+     "whole-block XLA compiles observed by jit_compile_counter", ()),
+    # -- numeric guardrails (resilience/guardrails.py) ----------------------
+    ("guard.events", COUNTER,
+     "StepGuard verdicts by action (skip/rewind/...)", ("action",)),
+    ("guard.step", EVENT,
+     "structured StepGuard event (the PR 4 health-vector verdicts)", ()),
+    # -- hang watchdog (resilience/watchdog.py) -----------------------------
+    ("watchdog.stalls", COUNTER, "StallError raises", ()),
+    ("watchdog.stall", EVENT,
+     "watchdog stall dump (what/window/in-flight state)", ()),
+    # -- autotuner provenance (tuning/policy.py) ----------------------------
+    ("tuning.decisions", COUNTER,
+     "decide() resolutions by (op, tier) — tier in db/analytic/default",
+     ("op", "tier")),
+    # -- tiered embeddings (embedding/engine.py) ----------------------------
+    ("emb.hit_ids", COUNTER,
+     "id occurrences served from the hot-ID cache", ("table",)),
+    ("emb.miss_ids", COUNTER,
+     "id occurrences that missed the cache (host-tier prefetch)",
+     ("table",)),
+    ("emb.evictions", COUNTER, "cache rows evicted (written back)",
+     ("table",)),
+    ("emb.writebacks", COUNTER, "dirty rows written back to the host tier",
+     ("table",)),
+    # -- pserver liveness (distributed/ps_rpc.py) ---------------------------
+    ("ps.evictions", COUNTER,
+     "trainers evicted from the sync barrier by the liveness monitor", ()),
+    ("ps.rejoins", COUNTER, "evicted trainers re-admitted", ()),
+    ("ps.liveness", EVENT, "evict/rejoin/grace-shutdown liveness record",
+     ()),
+    # -- SLO monitor (observability/slo.py) ---------------------------------
+    ("slo.breaches", COUNTER, "SLO rule breaches", ("rule", "severity")),
+    ("slo.breach", EVENT, "SLO breach record (rule, value, threshold)", ()),
+]
+
+DECLARED_NAMES = frozenset(spec[0] for spec in DECLARED)
+
+# the stage names every legacy profiler.bump/record_stage call site uses —
+# tests/test_observability.py greps the source tree against this set, so a
+# new bump("...") literal must be declared here to stay green
+STAGE_NAMES = frozenset(s[0] for s in DECLARED if s[1] == STAGE)

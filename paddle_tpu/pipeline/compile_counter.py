@@ -48,6 +48,9 @@ def jit_compile_counter(fn_name: str = "fn"):
             msg = record.getMessage()
             if msg.startswith(prefix):
                 result.events.append(msg)
+                from .. import observability as obs
+
+                obs.counter_inc("train.jit_compiles")
 
     handler = _Handler(level=logging.DEBUG)
     touched = []

@@ -275,6 +275,11 @@ class StepGuard:
         if detail:
             evt["detail"] = detail
         self.events.append(evt)
+        from .. import observability as obs
+
+        obs.counter_inc("guard.events", labels={"action": action})
+        obs.event("guard.step", evt,
+                  level="warning" if action != "note" else "info")
         if self.manager is not None:
             self.manager.record_guard_event(step_id, reason, action, detail)
 

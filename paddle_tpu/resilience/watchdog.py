@@ -22,10 +22,13 @@ demand so the watchdog path is testable on one healthy host.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from typing import Callable
 
 __all__ = ["StallError", "Watchdog", "stall_window_s", "runtime_state"]
+
+logger = logging.getLogger("paddle_tpu.resilience.watchdog")
 
 
 def stall_window_s() -> float:
@@ -52,6 +55,20 @@ class StallError(RuntimeError):
         super().__init__(
             f"{what}: no progress within {window_s:.3g}s "
             f"(FLAGS_watchdog_stall_s) — in-flight state:\n{dump}")
+        # structured copies of the dump: the exception message above stays
+        # the human-readable record, while the telemetry registry and the
+        # logging tree carry the same state for machine consumers
+        try:
+            from .. import observability as obs
+
+            obs.counter_inc("watchdog.stalls")
+            obs.event("watchdog.stall",
+                      {"what": what, "window_s": self.window_s,
+                       "state": self.state}, level="error")
+        except Exception:  # noqa: BLE001 — telemetry never masks the stall
+            pass
+        logger.error("stall: %s (no progress within %.3gs)", what, window_s,
+                     extra={"stall_state": self.state})
 
 
 class Watchdog:

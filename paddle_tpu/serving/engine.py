@@ -68,6 +68,7 @@ import time
 import numpy as np
 
 from .. import flags, unique_name
+from .. import observability as obs
 from ..data_feeder import _round_up_pow2
 from ..executor import Executor, Scope
 from ..framework import Program, program_guard
@@ -318,7 +319,9 @@ class ServingEngine:
         """Zero the counters (and the compile-signature sets) without
         touching the executor compile cache, the pool, or the prefix
         cache — the steady-state measurement boundary: warm the engine on
-        one pass of a workload, reset, measure the second pass."""
+        one pass of a workload, reset, measure the second pass. The
+        registry's `serving.` series reset with it so both views stay
+        scoped to the same measurement window."""
         for k, v in self.stats.items():
             if isinstance(v, set):
                 v.clear()
@@ -326,6 +329,38 @@ class ServingEngine:
                 self.stats[k] = 0.0
             else:
                 self.stats[k] = 0
+        obs.reset("serving.")
+
+    def _count(self, key: str, n: int = 1) -> None:
+        """Bump a stats counter AND its registry mirror (`serving.<key>`):
+        the dict stays the cheap in-process view, the registry carries the
+        same number out through snapshot/exporters."""
+        self.stats[key] += n
+        obs.counter_inc("serving." + key, n)
+
+    def stats_snapshot(self) -> dict:
+        """The stats dict plus derived rates, every divide guarded: a
+        snapshot taken before any decode/prefill/spec step reports 0.0
+        rather than raising ZeroDivisionError or emitting NaN (notably
+        spec_accept_rate with speculation enabled but no spec step yet).
+        Signature sets become bucket counts so the result is JSON-clean."""
+        st = self.stats
+        out = {k: (len(v) if isinstance(v, set) else v)
+               for k, v in st.items()}
+        out["spec_accept_rate"] = (
+            st["spec_accepted"] / st["spec_proposed"]
+            if st["spec_proposed"] else 0.0)
+        out["tokens_per_decode_step"] = (
+            st["decode_tokens"] / st["decode_steps"]
+            if st["decode_steps"] else 0.0)
+        denom = st["prefix_hit_tokens"] + st["prefill_tokens_computed"]
+        out["prefix_cache_hit_rate"] = (
+            st["prefix_hit_tokens"] / denom if denom else 0.0)
+        out["occupancy_mean"] = (
+            st["occupancy_sum"] / st["occupancy_n"]
+            if st["occupancy_n"] else 0.0)
+        out["leaked_pages"] = self.leaked_pages()
+        return out
 
     def _exec_target(self, prog: Program):
         """The executor target for `prog`: the bare program single-chip, a
@@ -352,6 +387,9 @@ class ServingEngine:
         req = GenRequest(rid, prompt, max_new_tokens, eos_id, sampling)
         self.requests[rid] = req
         self._waiting.append(req)
+        obs.event("serving.request", {"rid": rid, "phase": "queued",
+                                      "prompt_len": req.prompt_len,
+                                      "max_new_tokens": req.max_new_tokens})
         return rid
 
     def abort(self, rid: int) -> None:
@@ -368,7 +406,10 @@ class ServingEngine:
         self._release(req)
         req.state = ABORTED
         req.t_done = time.perf_counter()
-        self.stats["aborts"] += 1
+        self._count("aborts")
+        obs.event("serving.request",
+                  {"rid": rid, "phase": "aborted",
+                   "n_generated": req.n_generated}, level="warning")
 
     def has_work(self) -> bool:
         return bool(self._waiting or self._running)
@@ -439,7 +480,11 @@ class ServingEngine:
             if victim is not None:
                 self.abort(victim.rid)
         admitted = self._admit()
-        decoded = self._decode_once() if self._running else False
+        if self._running:
+            with obs.span("serving.decode"):
+                decoded = self._decode_once()
+        else:
+            decoded = False
         if not decoded and not admitted and self._waiting:
             need = min(self.pool.pages_for(len(r.all_tokens) + 1)
                        for r in self._waiting)
@@ -482,6 +527,8 @@ class ServingEngine:
             self.stats["peak_pages_in_use"], used)
         self.stats["occupancy_sum"] += used / self.pool.num_pages
         self.stats["occupancy_n"] += 1
+        obs.gauge_set("serving.pages_in_use", used)
+        obs.gauge_set("serving.pool_occupancy", used / self.pool.num_pages)
 
     def _admit(self) -> int:
         """Admit waiting requests in policy order until pages or inflight
@@ -496,7 +543,7 @@ class ServingEngine:
                 break
             matched: list[int] = []
             if self.prefix_cache is not None:
-                self.stats["prefix_lookups"] += 1
+                self._count("prefix_lookups")
                 matched = self.prefix_cache.match(
                     req.all_tokens[:req.prompt_len])
                 # pin the hit BEFORE allocating: the cache's own ref may be
@@ -515,11 +562,19 @@ class ServingEngine:
                 break
             req.pages = matched + private
             req.cached_len = len(matched) * self.page_size
-            self.stats["prefix_hit_tokens"] += req.cached_len
+            self._count("prefix_hit_tokens", req.cached_len)
             self._waiting.remove(req)
             req.admit_seq = self._admit_seq
             self._admit_seq += 1
-            self._prefill(req)
+            obs.histogram_observe("serving.queue_s",
+                                  time.perf_counter() - req.arrival_t)
+            obs.event("serving.request", {"rid": req.rid, "phase": "admitted",
+                                          "cached_len": req.cached_len,
+                                          "pages": len(req.pages)})
+            # no rid label: span labels flow to the histogram series key,
+            # and a per-request label would mint unbounded series
+            with obs.span("serving.prefill"):
+                self._prefill(req)
             admitted += 1
         return admitted
 
@@ -547,7 +602,7 @@ class ServingEngine:
         req.state = RUNNING
         self._running.append(req)
         if req.cached_len >= n:
-            self.stats["prefix_full_hits"] += 1
+            self._count("prefix_full_hits")
             self._register_prefix(req)
             return
         if req.cached_len > 0:
@@ -572,7 +627,7 @@ class ServingEngine:
                             self._window_io["last_logits"]],
                 scope=self._scope)
             self.stats["prefill_signatures"].add(("suffix", sb, pb))
-            self.stats["prefill_tokens_computed"] += suf
+            self._count("prefill_tokens_computed", suf)
         else:
             sb = self._seq_bucket(n)
             pb = max(len(req.pages), self.pool.pages_for(sb))
@@ -591,8 +646,8 @@ class ServingEngine:
                             self._prefill_io["last_logits"]],
                 scope=self._scope)
             self.stats["prefill_signatures"].add((sb, pb))
-            self.stats["prefill_tokens_computed"] += n
-        self.stats["prefills"] += 1
+            self._count("prefill_tokens_computed", n)
+        self._count("prefills")
         self._register_prefix(req)
         self._accept_token(req, self._first_token(req, nxt, lg))
 
@@ -609,12 +664,22 @@ class ServingEngine:
         now = time.perf_counter()
         if req.t_first_token is None:
             req.t_first_token = now
+            obs.histogram_observe("serving.ttft_s", now - req.arrival_t)
+            obs.event("serving.request",
+                      {"rid": req.rid, "phase": "first_token",
+                       "ttft_s": round(now - req.arrival_t, 9)})
         if req.is_done() or len(req.all_tokens) >= self.cfg.max_position:
             if req in self._running:
                 self._running.remove(req)
             self._release(req)
             req.state = FINISHED
             req.t_done = now
+            obs.histogram_observe("serving.request_s", now - req.arrival_t)
+            obs.event("serving.request",
+                      {"rid": req.rid, "phase": "finished",
+                       "n_generated": req.n_generated,
+                       "preemptions": req.preemptions,
+                       "request_s": round(now - req.arrival_t, 9)})
 
     def _cow(self, req: GenRequest, ordinal: int) -> bool:
         """Copy-on-write req's page `ordinal`: fresh page, one in-place
@@ -640,7 +705,7 @@ class ServingEngine:
             fetch_list=[], scope=self._scope)
         self.pool.release([old])
         req.pages[ordinal] = new[0]
-        self.stats["cow_copies"] += 1
+        self._count("cow_copies")
         return True
 
     def _ensure_writable(self, lookahead: int = 0) -> dict[int, int]:
@@ -692,7 +757,7 @@ class ServingEngine:
         self._release(req)
         req.state = WAITING
         req.preemptions += 1
-        self.stats["preemptions"] += 1
+        self._count("preemptions")
         # head of the waiting queue: a preempted request lost work, so it
         # outranks new arrivals under fcfs
         self._waiting.insert(0, req)
@@ -723,7 +788,7 @@ class ServingEngine:
                         self._decode_io["logits"]],
             scope=self._scope)
         nxt = np.asarray(nxt).reshape(-1)
-        self.stats["decode_steps"] += 1
+        self._count("decode_steps")
         self.stats["decode_signatures"].add((bb, pb))
         lg = None if all(r.sampling.is_greedy for r in rows) \
             else np.asarray(lg)
@@ -733,7 +798,7 @@ class ServingEngine:
             else:
                 rng = request_rng(self.seed, r.rid, r.n_generated)
                 t = sample_token(lg[i], r.sampling, rng)
-            self.stats["decode_tokens"] += 1
+            self._count("decode_tokens")
             self._accept_token(r, t)
         return True
 
@@ -782,8 +847,8 @@ class ServingEngine:
                         self._window_io["logits"]],
             scope=self._scope)
         toks = np.asarray(toks)
-        self.stats["decode_steps"] += 1
-        self.stats["spec_steps"] += 1
+        self._count("decode_steps")
+        self._count("spec_steps")
         self.stats["decode_signatures"].add((bb, pb))
         lg = None if all(r.sampling.is_greedy for r, _, _ in plans) \
             else np.asarray(lg)
@@ -793,17 +858,17 @@ class ServingEngine:
                 # draft acceptance is a greedy-only contract
                 rng = request_rng(self.seed, r.rid, r.n_generated)
                 t = sample_token(lg[i, 0], r.sampling, rng)
-                self.stats["decode_tokens"] += 1
+                self._count("decode_tokens")
                 self._accept_token(r, t)
                 continue
             m = 0
             while m < n_valid - 1 and int(drafts[m]) == int(toks[i, m]):
                 m += 1
-            self.stats["spec_proposed"] += n_valid - 1
-            self.stats["spec_accepted"] += m
+            self._count("spec_proposed", n_valid - 1)
+            self._count("spec_accepted", m)
             for j in range(m + 1):
                 if r.state != RUNNING:
                     break
-                self.stats["decode_tokens"] += 1
+                self._count("decode_tokens")
                 self._accept_token(r, int(toks[i, j]))
         return True

@@ -102,6 +102,9 @@ def _bump(op: str, tier: str) -> None:
     with _lock:
         c = _counters.setdefault(op, {"db": 0, "analytic": 0, "default": 0})
         c[tier] += 1
+    from .. import observability as obs
+
+    obs.counter_inc("tuning.decisions", labels={"op": op, "tier": tier})
 
 
 def reset_provenance() -> None:

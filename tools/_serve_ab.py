@@ -121,8 +121,13 @@ def run_open_loop(engine, workload, max_steps: int = 200_000,
     actually saturated. The prefix cache stays warm across passes — the
     sustained-serving regime a production engine lives in, and the only one
     where arms with different compile footprints compare honestly."""
+    from paddle_tpu import observability as obs
     from paddle_tpu.pipeline import jit_compile_counter
 
+    # scope the registry's serving series to THIS run: sequential bench
+    # arms share the one process-wide registry, and the telemetry block
+    # below must describe this engine's measured pass only
+    obs.reset("serving.")
     passes = 8 if warmup else 1
     n_compiles = 0
     clean_streak = 0
@@ -156,14 +161,13 @@ def run_open_loop(engine, workload, max_steps: int = 200_000,
             if r.t_first_token is not None]
     served_tokens = sum(r.n_generated for r in done)
     st = engine.stats
-    occ_mean = (st["occupancy_sum"] / st["occupancy_n"]
-                if st["occupancy_n"] else 0.0)
-    leaked = engine.leaked_pages()
+    ss = engine.stats_snapshot()  # every derived rate divide-guarded
+    leaked = ss["leaked_pages"]
+    obs.gauge_set("serving.leaked_pages", leaked)
     engine.flush_prefix_cache()
     # after drain + flush only a refcount bug can keep pages off-list
     refcount_leaks = engine.pool.num_pages - engine.pool.free_count
-    prefix_total = st["prefix_hit_tokens"] + st["prefill_tokens_computed"]
-    return {
+    out = {
         "requests": len(reqs),
         "finished": len(done),
         "aborted": sum(1 for r in reqs if r.state == "aborted"),
@@ -172,7 +176,7 @@ def run_open_loop(engine, workload, max_steps: int = 200_000,
         "served_tokens_per_sec": round(served_tokens / wall, 2) if wall else 0.0,
         "request_latency": _timing.latency_stats(lat),
         "first_token_latency": _timing.latency_stats(ttft),
-        "kv_pool_occupancy_mean": round(occ_mean, 4),
+        "kv_pool_occupancy_mean": round(ss["occupancy_mean"], 4),
         "kv_pool_occupancy_peak": round(
             st["peak_pages_in_use"] / engine.pool.num_pages, 4),
         "kv_pages_leaked": leaked,
@@ -186,18 +190,41 @@ def run_open_loop(engine, workload, max_steps: int = 200_000,
         # prefix caching (ISSUE 11): how much prefill the cache absorbed
         "prefill_tokens_computed": st["prefill_tokens_computed"],
         "prefix_hit_tokens": st["prefix_hit_tokens"],
-        "prefix_cache_hit_rate": round(
-            st["prefix_hit_tokens"] / prefix_total, 4) if prefix_total else 0.0,
+        "prefix_cache_hit_rate": round(ss["prefix_cache_hit_rate"], 4),
         "prefix_full_hits": st["prefix_full_hits"],
         "cow_copies": st["cow_copies"],
         # speculative decoding (ISSUE 11): accepted-token rate
         "spec_steps": st["spec_steps"],
-        "spec_accept_rate": round(
-            st["spec_accepted"] / st["spec_proposed"], 4)
-        if st["spec_proposed"] else 0.0,
-        "tokens_per_decode_step": round(
-            st["decode_tokens"] / st["decode_steps"], 3)
-        if st["decode_steps"] else 0.0,
+        "spec_accept_rate": round(ss["spec_accept_rate"], 4),
+        "tokens_per_decode_step": round(ss["tokens_per_decode_step"], 3),
+    }
+    out["telemetry"] = _registry_view(obs.snapshot())
+    return out
+
+
+def _registry_view(snap: dict) -> dict:
+    """The registry's read of the run just measured (ISSUE 13): the same
+    TTFT/queue/occupancy numbers as the stamp-based block above, but read
+    back through the one snapshot() every surface now lands in — the
+    acceptance check that the serving path is actually registry-backed."""
+    def _ms(name, key):
+        h = snap.get("histograms", {}).get(name)
+        v = h.get(key) if h else None
+        return round(v * 1e3, 3) if v is not None else None
+
+    return {
+        "ttft_ms_p50": _ms("serving.ttft_s", "p50"),
+        "ttft_ms_p99": _ms("serving.ttft_s", "p99"),
+        "queue_ms_p50": _ms("serving.queue_s", "p50"),
+        "queue_ms_p99": _ms("serving.queue_s", "p99"),
+        "request_ms_p50": _ms("serving.request_s", "p50"),
+        "request_ms_p99": _ms("serving.request_s", "p99"),
+        "pool_occupancy": snap.get("gauges", {}).get(
+            "serving.pool_occupancy"),
+        "registry_decode_steps": snap.get("counters", {}).get(
+            "serving.decode_steps", 0),
+        "registry_cow_copies": snap.get("counters", {}).get(
+            "serving.cow_copies", 0),
     }
 
 

@@ -19,6 +19,9 @@ every end-of-round snapshot commit:
     python tools/gate.py --kernels         # Pallas kernel-registry lint
                                            # only (reference + equivalence
                                            # test + tuner key per kernel)
+    python tools/gate.py --obs [F.json]    # telemetry block only (registry
+                                           # overhead ceiling, metric-name
+                                           # schema drift, missing block)
 """
 from __future__ import annotations
 
@@ -89,6 +92,11 @@ MC_PARITY_DRIFT = 5e-3
 # real chips per-device efficiency is the honest floor.
 MC_CPU_SPEEDUP_FLOOR = 0.05
 MC_EFFICIENCY_FLOOR = 0.5
+
+# unified telemetry layer (ISSUE 13): the registry rides every hot loop
+# (async dispatch drain, serving scheduler), so its measured cost over the
+# legacy accumulators must stay ~free — same ceiling as the health sentinel
+OBS_OVERHEAD_CEIL_PCT = 2.0
 
 
 def run_suite() -> int:
@@ -502,6 +510,84 @@ def check_multichip(path: str | None = None) -> int:
     return rc
 
 
+def _check_obs(data: dict, label: str, require: bool = False) -> int:
+    """Telemetry-block gate (ISSUE 13). Three failure modes:
+      * missing block (only when `require` — artifacts predating the layer
+        stay green under the plain bench gate; `--obs` demands it);
+      * registry overhead above OBS_OVERHEAD_CEIL_PCT — the layer rides
+        every hot loop, so measurable cost is a perf bug, not a feature;
+      * metric-name drift: any name the run recorded that the declared
+        schema (paddle_tpu/observability/schema.py) does not list — an
+        undeclared metric is a lint error, because name drift is how
+        dashboards and SLO rules silently go dark."""
+    blk = data.get("telemetry")
+    if not isinstance(blk, dict):
+        if require:
+            print(f"[gate] FAIL: {label} carries no telemetry block — "
+                  f"bench.py must measure the registry A/B "
+                  f"(bench_telemetry) for --obs to pass", flush=True)
+            return 1
+        return 0
+    rc = 0
+    pct = blk.get("obs_overhead_pct")
+    print(f"[gate] bench {label}: telemetry overhead {pct}% "
+          f"(on {blk.get('examples_per_sec_obs_on')} vs off "
+          f"{blk.get('examples_per_sec_obs_off')} ex/s)", flush=True)
+    if pct is None or pct > OBS_OVERHEAD_CEIL_PCT:
+        print(f"[gate] FAIL: the telemetry registry costs {pct}% "
+              f"(> {OBS_OVERHEAD_CEIL_PCT}%) of async-dispatch throughput "
+              f"— instrumentation must stay ~free; check what landed on "
+              f"the per-step path (histogram in a lock? sink doing I/O "
+              f"inline?) before shipping", flush=True)
+        rc = 1
+    undeclared = blk.get("undeclared_metrics")
+    if undeclared:
+        print(f"[gate] FAIL: metrics recorded outside the declared schema: "
+              f"{undeclared} — declare them in paddle_tpu/observability/"
+              f"schema.py (with kind + help) or fix the call site's name",
+              flush=True)
+        rc = 1
+    names = blk.get("metric_names")
+    if names:
+        sys.path.insert(0, REPO)
+        from paddle_tpu.observability import schema
+
+        drift = sorted(n for n in names
+                       if n.split("{")[0] not in schema.DECLARED_NAMES
+                       and not n.endswith(".seconds"))
+        if drift:
+            print(f"[gate] FAIL: artifact metric names not in "
+                  f"observability/schema.py: {drift} — schema and emitters "
+                  f"drifted apart", flush=True)
+            rc = 1
+        else:
+            print(f"[gate] bench {label}: {len(names)} metric names, all "
+                  f"declared", flush=True)
+    return rc
+
+
+def check_obs(path: str | None = None) -> int:
+    """`--obs`: gate the newest (or given) bench artifact's telemetry block
+    only, and REQUIRE the block to exist."""
+    arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if path is None:
+        if not arts:
+            print("[gate] WARN: no BENCH_r*.json artifact", flush=True)
+            return 0
+        path = arts[-1]
+    try:
+        with open(path) as f:
+            data = _bench_metrics(f.read())
+    except (OSError, ValueError) as e:
+        print(f"[gate] WARN: cannot read bench artifact {path}: {e}",
+              flush=True)
+        return 0
+    if data is None:
+        print(f"[gate] WARN: no bench metrics line in {path}", flush=True)
+        return 0
+    return _check_obs(data, os.path.basename(path), require=True)
+
+
 def check_bench(path: str | None = None) -> int:
     """Flag a DeepFM end-to-end/device-path regression in the bench artifact.
 
@@ -537,6 +623,8 @@ def check_bench(path: str | None = None) -> int:
         return 1
     if _check_embedding(data, prev_path, os.path.basename(path)):
         return 1
+    if _check_obs(data, os.path.basename(path)):
+        return 1
     ratio = data.get("deepfm_e2e_device_ratio")
     if ratio is None:
         return 0  # artifact predates the pipeline ratio
@@ -567,6 +655,9 @@ def check_bench(path: str | None = None) -> int:
 
 
 def main() -> int:
+    if "--obs" in sys.argv:
+        arg = sys.argv[sys.argv.index("--obs") + 1:]
+        return check_obs(arg[0] if arg else None)
     if "--bench" in sys.argv:
         arg = sys.argv[sys.argv.index("--bench") + 1:]
         return check_bench(arg[0] if arg else None)
