@@ -787,6 +787,11 @@ def bench_serving(on_tpu: bool):
     out["config"] = ("dec6x512 b16 pool2048x16 open-loop r32" if on_tpu
                      else "tiny pool64x4 open-loop r16")
     out["shared_prefix"] = _bench_shared_prefix(on_tpu)
+    # ISSUE 14: overload resilience — the shared-prefix mix at 10x the r8
+    # rate against shed floors + the degradation ladder, plus the same
+    # trace under a bounded serving fault plan; gate.py enforces goodput
+    # >= 0.7x the unloaded arm and zero leaks in every arm
+    out["overload"] = _serve_ab.overload_block(on_tpu)
     return out
 
 

@@ -267,6 +267,57 @@ _define("serving_tp", 1,
         "(parallel/mesh.make_tp_mesh + GSPMD annotations); "
         "paged_decode_attention keys the tuning DB on the per-shard "
         "(nh/tp) shape. Must divide the model's num_heads; 1 disables")
+# serving resilience knobs (deadlines, shedding, degradation, supervision —
+# see README "Serving resilience")
+_define("serving_deadline_s", 0.0,
+        "default per-request TTL in seconds, measured from submit: a "
+        "request past its deadline is expired at admission and between "
+        "decode steps with every KV page returned, surfaced as the "
+        "'deadline_exceeded' terminal state (partial tokens kept). "
+        "Per-request `deadline_s=` on submit overrides; <=0 (default) "
+        "means no deadline")
+_define("serving_priority_default", 1,
+        "priority class assigned to requests submitted without an explicit "
+        "priority (higher = more important). Under overload the shedder "
+        "evicts lowest-priority WAITING requests first; ties shed the "
+        "youngest")
+_define("serving_shed_occupancy", 0.0,
+        "admission-control floor on KV pool occupancy: when pages_in_use / "
+        "num_pages crosses this fraction, new submits shed lower-priority "
+        "waiters or are rejected with a retry-after hint "
+        "(AdmissionRejected) instead of queueing unboundedly. <=0 "
+        "(default) disables the occupancy trigger")
+_define("serving_shed_queue_depth", 0,
+        "admission-control floor on waiting-queue depth: a submit that "
+        "would leave more than this many requests WAITING sheds "
+        "lower-priority waiters or is rejected (AdmissionRejected). <=0 "
+        "(default) disables the depth trigger")
+_define("serving_shed_ttft_p99_ms", 0.0,
+        "SLO floor on p99 time-to-first-token in milliseconds, read from "
+        "the serving.ttft_s histogram via the SloMonitor: while p99 TTFT "
+        "sits above this, new submits shed or reject exactly as under the "
+        "occupancy/depth triggers. Needs FLAGS_obs_enable for the "
+        "histogram to populate; <=0 (default) disables the SLO trigger")
+_define("serving_degrade_after", 4,
+        "graceful-degradation ladder patience: consecutive overloaded "
+        "scheduler steps before climbing one rung (disable speculative "
+        "decode -> shrink decode lookahead -> evict prefix-cache LRU tail "
+        "-> shed waiters), and consecutive calm steps before descending "
+        "one. Each climb is counted (serving.ladder.*) and evented")
+_define("serving_step_retries", 3,
+        "engine supervisor: max attempts for one compiled "
+        "prefill/decode/window/COW dispatch under the serving RetryPolicy "
+        "(transient transport faults retry with millisecond backoff; the "
+        "compiled step writes fixed slots so a retry is idempotent). "
+        "Exhaustion triggers the recovery pass: quarantine poisoned "
+        "requests, audit + rebuild the pool, replay survivors from their "
+        "prompts")
+_define("serving_audit_every", 16,
+        "run the PagedKVPool.check_consistency invariant audit (free list "
+        "and mapped ordinals partition the pool; refcounts equal live "
+        "holder counts) every N scheduler steps; a dirty audit triggers "
+        "the recovery pass. 1 audits every step (chaos drills); <=0 "
+        "disables the periodic audit")
 # tiered giant-embedding knobs (paddle_tpu/embedding/, the minimize()-time
 # rewrite in passes.rewrite_tiered_embeddings — see README "Tiered
 # embeddings")

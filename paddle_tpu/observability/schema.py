@@ -73,8 +73,44 @@ DECLARED: list[tuple] = [
     ("serving.decode.seconds", HISTOGRAM,
      "decode-step span durations (also a TraceAnnotation in XPlane)", ()),
     ("serving.request", EVENT,
-     "per-request lifecycle record: queued/admitted/first_token/"
-     "finished/aborted", ("rid", "phase")),
+     "per-request lifecycle record: queued/admitted/first_token/finished/"
+     "aborted/deadline_exceeded/shed/rejected/quarantined",
+     ("rid", "phase")),
+    # -- serving resilience (ISSUE 14: deadlines/shedding/supervision) ------
+    ("serving.deadline_exceeded", COUNTER,
+     "requests expired past their TTL (at admission or between steps)", ()),
+    ("serving.shed", COUNTER,
+     "WAITING requests shed by admission control or the ladder", ()),
+    ("serving.rejects", COUNTER,
+     "submits rejected with AdmissionRejected (retry-after surfaced)", ()),
+    ("serving.step_retries", COUNTER,
+     "compiled-step dispatch retries absorbed by the supervisor", ()),
+    ("serving.recovery.passes", COUNTER,
+     "engine recovery passes (quarantine + pool rebuild + replay)", ()),
+    ("serving.recovery.replayed", COUNTER,
+     "surviving requests replayed from their prompts by recovery", ()),
+    ("serving.recovery.quarantined", COUNTER,
+     "poisoned requests quarantined (aborted, pages forfeited) by "
+     "recovery", ()),
+    ("serving.ladder.spec_off", COUNTER,
+     "degradation-ladder climbs to rung 1: speculative decode off", ()),
+    ("serving.ladder.lookahead_shrink", COUNTER,
+     "degradation-ladder climbs to rung 2: admission reserves no decode "
+     "lookahead page", ()),
+    ("serving.ladder.cache_evict", COUNTER,
+     "degradation-ladder climbs to rung 3: prefix-cache LRU tail "
+     "evicted under pressure", ()),
+    ("serving.ladder.shed", COUNTER,
+     "degradation-ladder climbs to rung 4: lowest-priority waiters "
+     "shed", ()),
+    ("serving.ladder_rung", GAUGE,
+     "current degradation-ladder rung (0 = nominal .. 4 = shedding)", ()),
+    ("serving.degrade", EVENT,
+     "ladder transition record (rung, direction, pressure signals)", ()),
+    ("serving.recovery", EVENT,
+     "recovery-pass record (reason, quarantined, replayed, problems)", ()),
+    ("serving.step_retry", EVENT,
+     "one absorbed dispatch retry (kind, attempt, error)", ()),
     # -- training step telemetry (executor.py async window) -----------------
     ("train.steps", COUNTER, "async steps drained to completion", ()),
     ("train.step_latency_s", HISTOGRAM,

@@ -44,6 +44,25 @@ host, reproducibly. This module plants named *sites* in the hot paths —
                       must return to the free list; the chaos test drives
                       repeated abort cycles and asserts the pool leaks
                       zero pages
+    serving_step_fail ServingEngine._dispatch, before every compiled
+                      prefill/decode/window/COW step — the dispatch fails
+                      like a lost device transport; the engine's
+                      RetryPolicy must absorb isolated hits, and a run of
+                      hits exhausting the attempts must trigger the
+                      recovery pass (quarantine + pool rebuild + replay),
+                      never a poisoned batch
+    serving_pool_corrupt
+                      ServingEngine.step, once per scheduler iteration —
+                      one piece of host-side pool bookkeeping is
+                      vandalized (phantom refcount holder, live page
+                      pushed back on the free list, or a duplicate
+                      ordinal in a request's page table); the periodic
+                      PagedKVPool.check_consistency audit must detect it
+                      and the recovery pass must rebuild a clean pool
+    serving_deadline  ServingEngine.step, once per scheduler iteration —
+                      the oldest live request's deadline is forced into
+                      the past, so the expiry machinery must surface it
+                      as deadline_exceeded with every page returned
     emb_host_stall    the tiered-embedding miss resolver
                       (embedding/engine.resolve_feed) — the host-tier
                       prefetch parks forever (a hung remote shard / page-in
@@ -82,7 +101,8 @@ FAULT_SITES = frozenset({
     "ckpt.write", "ps.send", "ps.recv", "collective.step", "executor.compile",
     "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
     "collective_stall", "numeric_nan", "numeric_spike", "serving_abort",
-    "emb_host_stall",
+    "emb_host_stall", "serving_step_fail", "serving_pool_corrupt",
+    "serving_deadline",
 })
 
 

@@ -20,7 +20,7 @@ import hashlib
 import time
 from typing import Callable, Iterable
 
-__all__ = ["RetryPolicy", "rpc_policy", "io_policy"]
+__all__ = ["RetryPolicy", "rpc_policy", "io_policy", "serving_policy"]
 
 _TRANSIENT = (ConnectionError, EOFError, TimeoutError, OSError)
 
@@ -107,6 +107,21 @@ def _from_flags(**overrides) -> RetryPolicy:
 def rpc_policy(**overrides) -> RetryPolicy:
     """Policy for pserver RPCs, configured from FLAGS_retry_*."""
     return _from_flags(**overrides)
+
+
+def serving_policy(**overrides) -> RetryPolicy:
+    """Policy for serving-engine step dispatch: fast, tightly bounded
+    attempts with no wall-clock deadline — a decode step is milliseconds,
+    so backoff at checkpoint-I/O scale would stall every request in the
+    batch. Attempt count from FLAGS_serving_step_retries; exhaustion is
+    the engine supervisor's signal to run the recovery pass."""
+    from .. import flags
+
+    kw = dict(
+        max_attempts=max(1, flags.get_flag("serving_step_retries")),
+        base_delay=0.001, max_delay=0.02, deadline=None)
+    kw.update(overrides)
+    return RetryPolicy(**kw)
 
 
 def io_policy(**overrides) -> RetryPolicy:

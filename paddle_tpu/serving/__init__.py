@@ -31,8 +31,8 @@ the --shared-prefix zipf mix + --ab baseline arm) and the bench.py
 `serving` block (served tokens/s, p50/p99 latency, pool occupancy, the
 three-arm shared_prefix A/B) gated by tools/gate.py.
 """
-from .engine import (ContinuousBatchingScheduler, GenRequest, ServingEngine,
-                     ngram_draft)
+from .engine import (AdmissionRejected, ContinuousBatchingScheduler,
+                     GenRequest, ServingEngine, ngram_draft)
 from .kv_cache import (PagedKVPool, PrefixCache, create_device_pools,
                        pool_var_names)
 from .model import (DecoderConfig, build_decode_program,
@@ -42,6 +42,7 @@ from .sampling import SamplingParams, sample_token
 
 __all__ = [
     "ServingEngine", "GenRequest", "ContinuousBatchingScheduler",
+    "AdmissionRejected",
     "PagedKVPool", "PrefixCache", "pool_var_names", "create_device_pools",
     "DecoderConfig", "decoder_tiny", "build_prefill_program",
     "build_decode_program", "build_window_program",

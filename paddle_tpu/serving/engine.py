@@ -60,6 +60,25 @@ Failure/backpressure semantics:
   * abort (client gone, or the `serving_abort` chaos fault site): the
     request's refcounts release immediately; pages nobody else maps return
     to the free list — the zero-leak invariant the chaos test pins down.
+
+Resilience layer (ISSUE 14 — see README "Serving resilience"):
+  * DEADLINES — a per-request TTL checked at admission and between decode
+    steps; an expired request keeps its partial tokens, returns every page,
+    and finishes in the distinct `deadline_exceeded` terminal state;
+  * ADMISSION CONTROL — when pool occupancy / queue depth / p99 TTFT (read
+    through the SloMonitor) cross the FLAGS_serving_shed_* floors, submit()
+    sheds lower-priority WAITING requests first and then rejects with
+    `AdmissionRejected` (retry-after hint) instead of queueing unboundedly;
+  * a graceful-DEGRADATION ladder under sustained pressure, the StepGuard
+    ladder's serving twin: speculative decode off -> no decode-lookahead
+    reservation at admission -> prefix-cache LRU eviction -> shed, one rung
+    per FLAGS_serving_degrade_after pressured steps, descending when calm;
+  * SUPERVISION — every compiled dispatch runs under a RetryPolicy (the
+    `serving_step_fail` site injects there); retry exhaustion or a dirty
+    `PagedKVPool.check_consistency` audit (`serving_pool_corrupt` injects
+    the damage) triggers the recovery pass: quarantine poisoned requests,
+    rebuild the pool pristine, replay survivors from their prompts —
+    bitwise-equal to a fault-free greedy run.
 """
 from __future__ import annotations
 
@@ -72,15 +91,46 @@ from .. import observability as obs
 from ..data_feeder import _round_up_pow2
 from ..executor import Executor, Scope
 from ..framework import Program, program_guard
+from ..observability.slo import hist_p99_above
 from ..resilience.faults import InjectedFault, fault_point
+from ..resilience.retry import serving_policy
 from . import model as sv_model
 from .kv_cache import PagedKVPool, PrefixCache, create_device_pools
 from .sampling import SamplingParams, request_rng, sample_token
 
 __all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine",
-           "ngram_draft"]
+           "AdmissionRejected", "ngram_draft"]
 
 WAITING, RUNNING, FINISHED, ABORTED = "waiting", "running", "finished", "aborted"
+DEADLINE_EXCEEDED, SHED = "deadline_exceeded", "shed"
+# the states a request never leaves; pop_result/prune accept any of them
+_TERMINAL = frozenset({FINISHED, ABORTED, DEADLINE_EXCEEDED, SHED})
+# graceful-degradation ladder rungs, mildest first (see _update_ladder)
+_LADDER_RUNGS = {1: "spec_off", 2: "lookahead_shrink",
+                 3: "cache_evict", 4: "shed"}
+
+
+class AdmissionRejected(RuntimeError):
+    """submit() refused the request under overload: an explicit shed with a
+    retry-after hint instead of unbounded queueing. `signals` carries the
+    tripped triggers (occupancy / queue_depth / ttft_p99_s)."""
+
+    def __init__(self, reason: str, retry_after_s: float, signals: dict):
+        super().__init__(f"admission rejected ({reason}); retry after "
+                         f"~{retry_after_s}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.signals = dict(signals)
+
+
+class _StepFailure(RuntimeError):
+    """A compiled dispatch failed past its retry budget; step() converts it
+    into a recovery pass instead of letting it poison the batch."""
+
+    def __init__(self, kind: str, cause: BaseException):
+        super().__init__(f"{kind} dispatch failed after retries: {cause}")
+        self.kind = kind
+        self.cause = cause
 
 
 def ngram_draft(tokens, k: int, window: int = 128) -> list[int]:
@@ -118,7 +168,8 @@ class GenRequest:
     """
 
     def __init__(self, rid: int, prompt, max_new_tokens: int, eos_id=None,
-                 sampling: "SamplingParams | None" = None):
+                 sampling: "SamplingParams | None" = None,
+                 deadline_s: float | None = None, priority: int = 1):
         if not len(prompt):
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -135,6 +186,11 @@ class GenRequest:
         self.admit_seq = -1      # admission order; preemption evicts the newest
         self.preemptions = 0
         self.arrival_t = time.perf_counter()
+        self.priority = int(priority)  # higher = more important to keep
+        # wall-clock TTL: an expired request keeps its partial tokens but
+        # releases every page (the deadline_exceeded terminal state)
+        self.deadline_t = (self.arrival_t + float(deadline_s)
+                           if deadline_s and deadline_s > 0 else None)
         self.t_first_token: float | None = None
         self.t_done: float | None = None
 
@@ -188,7 +244,15 @@ class ServingEngine:
                  seed: int = 0,
                  prefix_cache: bool | None = None,
                  draft_k: int | None = None,
-                 tp: int | None = None):
+                 tp: int | None = None,
+                 deadline_s: float | None = None,
+                 priority_default: int | None = None,
+                 shed_occupancy: float | None = None,
+                 shed_queue_depth: int | None = None,
+                 shed_ttft_p99_ms: float | None = None,
+                 degrade_after: int | None = None,
+                 step_retries: int | None = None,
+                 audit_every: int | None = None):
         self.cfg = cfg or sv_model.decoder_tiny()
         self.page_size = int(page_size
                              or flags.get_flag("serving_page_size"))
@@ -206,6 +270,51 @@ class ServingEngine:
         if self.draft_k < 0:
             raise ValueError(f"draft_k must be >= 0, got {self.draft_k}")
         self.seed = int(seed)
+        # resilience knobs (ISSUE 14): deadlines, shedding, supervision —
+        # every default keeps the machinery off/cheap (see flags.py)
+        self.deadline_s = float(
+            deadline_s if deadline_s is not None
+            else flags.get_flag("serving_deadline_s"))
+        self.priority_default = int(
+            priority_default if priority_default is not None
+            else flags.get_flag("serving_priority_default"))
+        self.shed_occupancy = float(
+            shed_occupancy if shed_occupancy is not None
+            else flags.get_flag("serving_shed_occupancy"))
+        self.shed_queue_depth = int(
+            shed_queue_depth if shed_queue_depth is not None
+            else flags.get_flag("serving_shed_queue_depth"))
+        self.shed_ttft_p99_ms = float(
+            shed_ttft_p99_ms if shed_ttft_p99_ms is not None
+            else flags.get_flag("serving_shed_ttft_p99_ms"))
+        self.degrade_after = max(1, int(
+            degrade_after if degrade_after is not None
+            else flags.get_flag("serving_degrade_after")))
+        self.audit_every = int(
+            audit_every if audit_every is not None
+            else flags.get_flag("serving_audit_every"))
+        retries = int(step_retries if step_retries is not None
+                      else flags.get_flag("serving_step_retries"))
+        self._retry = serving_policy(max_attempts=max(1, retries),
+                                     seed=self.seed)
+        self._slo = None
+        if self.shed_ttft_p99_ms > 0:
+            # a private monitor over the default registry with muted
+            # callbacks: the breach verdicts still land on the slo.* series,
+            # the engine just reads them as one more overload signal
+            self._slo = obs.SloMonitor(
+                window_s=30.0, alert_after=1,
+                on_warn=lambda b: None, on_alert=lambda b: None)
+            self._slo.add_rule(
+                "serving_ttft_p99",
+                hist_p99_above("serving.ttft_s",
+                               self.shed_ttft_p99_ms / 1e3),
+                self.shed_ttft_p99_ms / 1e3,
+                "p99 TTFT above the shed floor")
+        self._ladder_rung = 0
+        self._pressure_steps = 0
+        self._calm_steps = 0
+        self._step_i = 0
         self.pool = PagedKVPool(self.pool_pages, self.page_size)
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
         self._exe = Executor()
@@ -269,6 +378,13 @@ class ServingEngine:
             "prefix_lookups": 0, "prefix_full_hits": 0, "cow_copies": 0,
             # speculative decoding (ISSUE 11)
             "spec_steps": 0, "spec_proposed": 0, "spec_accepted": 0,
+            # resilience (ISSUE 14) — dotted keys mirror to the registry
+            # verbatim through _count ("serving." + key)
+            "deadline_exceeded": 0, "shed": 0, "rejects": 0,
+            "step_retries": 0, "recovery.passes": 0,
+            "recovery.replayed": 0, "recovery.quarantined": 0,
+            "ladder.spec_off": 0, "ladder.lookahead_shrink": 0,
+            "ladder.cache_evict": 0, "ladder.shed": 0,
         }
 
     def warmup_decode(self, max_context: int | None = None) -> int:
@@ -375,41 +491,59 @@ class ServingEngine:
 
     # -- client API ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, eos_id=None,
-               sampling: "SamplingParams | dict | None" = None) -> int:
+               sampling: "SamplingParams | dict | None" = None,
+               deadline_s: float | None = None,
+               priority: int | None = None) -> int:
+        """Queue one request. `deadline_s`/`priority` default to the
+        engine-wide knobs (FLAGS_serving_deadline_s /
+        FLAGS_serving_priority_default). Under overload (any
+        FLAGS_serving_shed_* floor tripped) this sheds WAITING requests of
+        strictly lower priority to make room, and raises AdmissionRejected
+        with a retry-after hint when that is not enough — explicit refusal
+        instead of an unbounded queue."""
         if len(prompt) + max_new_tokens > self.cfg.max_position:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_position {self.cfg.max_position}")
         if isinstance(sampling, dict):
             sampling = SamplingParams(**sampling)
+        if priority is None:
+            priority = self.priority_default
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        sig = self._overload_signals()
+        while sig and self._shed_one(max_priority=int(priority)):
+            sig = self._overload_signals()
+        if sig:
+            retry_after = round(
+                0.05 * max(1, len(self._waiting) + len(self._running)), 3)
+            self._count("rejects")
+            obs.event("serving.request",
+                      {"rid": -1, "phase": "rejected", "signals": sig,
+                       "retry_after_s": retry_after}, level="warning")
+            raise AdmissionRejected(",".join(sorted(sig)), retry_after, sig)
         rid = self._next_rid
         self._next_rid += 1
-        req = GenRequest(rid, prompt, max_new_tokens, eos_id, sampling)
+        req = GenRequest(rid, prompt, max_new_tokens, eos_id, sampling,
+                         deadline_s=deadline_s, priority=int(priority))
         self.requests[rid] = req
         self._waiting.append(req)
         obs.event("serving.request", {"rid": rid, "phase": "queued",
                                       "prompt_len": req.prompt_len,
+                                      "priority": req.priority,
                                       "max_new_tokens": req.max_new_tokens})
         return rid
 
     def abort(self, rid: int) -> None:
         """Drop a request wherever it is; its page refcounts release
         immediately and pages nobody else maps return to the free list
-        (the zero-leak contract the chaos test asserts)."""
+        (the zero-leak contract the chaos test asserts). A WAITING request
+        leaves the admission queue AND releases any prefix-cache pages a
+        failed admission attempt left pinned on it."""
         req = self.requests.get(rid)
-        if req is None or req.state in (FINISHED, ABORTED):
+        if req is None or req.state in _TERMINAL:
             return
-        if req in self._waiting:
-            self._waiting.remove(req)
-        if req in self._running:
-            self._running.remove(req)
-        self._release(req)
-        req.state = ABORTED
-        req.t_done = time.perf_counter()
-        self._count("aborts")
-        obs.event("serving.request",
-                  {"rid": rid, "phase": "aborted",
-                   "n_generated": req.n_generated}, level="warning")
+        self._terminate(req, ABORTED, "aborts")
 
     def has_work(self) -> bool:
         return bool(self._waiting or self._running)
@@ -418,23 +552,24 @@ class ServingEngine:
         return list(self.requests[rid].out_tokens)
 
     def pop_result(self, rid: int) -> list[int]:
-        """Return a FINISHED/ABORTED request's generated tokens and drop its
+        """Return a terminal request's generated tokens and drop its
         record. `requests` otherwise retains every completed request (full
         token list included) for the engine's lifetime — unbounded growth
         and ever-slower leak accounting under continuous serving."""
         req = self.requests[rid]
-        if req.state not in (FINISHED, ABORTED):
+        if req.state not in _TERMINAL:
             raise ValueError(
-                f"request {rid} is {req.state}; only finished/aborted "
-                f"results can be popped")
+                f"request {rid} is {req.state}; only terminal "
+                f"(finished/aborted/deadline_exceeded/shed) results can "
+                f"be popped")
         del self.requests[rid]
         return list(req.out_tokens)
 
     def prune_finished(self) -> int:
-        """Drop every FINISHED/ABORTED request record (results the caller
-        has already read or will never read). Returns records dropped."""
+        """Drop every terminal request record (results the caller has
+        already read or will never read). Returns records dropped."""
         done = [rid for rid, r in self.requests.items()
-                if r.state in (FINISHED, ABORTED)]
+                if r.state in _TERMINAL]
         for rid in done:
             del self.requests[rid]
         return len(done)
@@ -470,7 +605,30 @@ class ServingEngine:
     # -- the scheduler iteration --------------------------------------------
     def step(self) -> bool:
         """One continuous-batching iteration; returns True if any request
-        made progress (admitted or decoded a token)."""
+        made progress (admitted or decoded a token). Supervised: a compiled
+        dispatch that exhausts its retry budget becomes a recovery pass
+        (quarantine + pool rebuild + prompt replay) instead of a poisoned
+        batch."""
+        self._step_i += 1
+        try:
+            return self._step_inner()
+        except _StepFailure as e:
+            self._recover(f"step_fail:{e.kind}")
+            return True
+
+    def _step_inner(self) -> bool:
+        try:
+            fault_point("serving_deadline")
+        except InjectedFault:
+            # chaos: the oldest live request's deadline collapses to the past
+            victim = self._running[0] if self._running else (
+                self._waiting[0] if self._waiting else None)
+            if victim is not None:
+                victim.deadline_t = time.perf_counter() - 1e-9
+        try:
+            fault_point("serving_pool_corrupt")
+        except InjectedFault as e:
+            self._corrupt_pool(e.hit)
         try:
             fault_point("serving_abort")
         except InjectedFault:
@@ -479,12 +637,23 @@ class ServingEngine:
                 self._waiting[0] if self._waiting else None)
             if victim is not None:
                 self.abort(victim.rid)
+        self._expire_deadlines(time.perf_counter())
+        if self.audit_every > 0 and self._step_i % self.audit_every == 0:
+            problems, poisoned = self.audit_pool()
+            if problems:
+                self._recover("pool_corrupt", poisoned=poisoned,
+                              problems=problems)
+                return True
+        self._update_ladder()
         admitted = self._admit()
         if self._running:
             with obs.span("serving.decode"):
                 decoded = self._decode_once()
         else:
             decoded = False
+        # a request that crossed its TTL inside the prefill/decode above is
+        # caught here — "mid-step" expiry still releases pages this step
+        self._expire_deadlines(time.perf_counter())
         if not decoded and not admitted and self._waiting:
             need = min(self.pool.pages_for(len(r.all_tokens) + 1)
                        for r in self._waiting)
@@ -530,6 +699,244 @@ class ServingEngine:
         obs.gauge_set("serving.pages_in_use", used)
         obs.gauge_set("serving.pool_occupancy", used / self.pool.num_pages)
 
+    # -- resilience: deadlines, shedding, the degradation ladder ------------
+    def _terminate(self, req: GenRequest, state: str, counter: str,
+                   extra: dict | None = None,
+                   level: str = "warning") -> None:
+        """Shared terminal transition: drop the request from whichever
+        queue holds it, release every page it maps (including a WAITING
+        request's pinned prefix-cache pages), stamp the state, count and
+        event it."""
+        if req in self._waiting:
+            self._waiting.remove(req)
+        if req in self._running:
+            self._running.remove(req)
+        self._release(req)
+        req.state = state
+        req.t_done = time.perf_counter()
+        self._count(counter)
+        payload = {"rid": req.rid, "phase": state,
+                   "n_generated": req.n_generated}
+        if extra:
+            payload.update(extra)
+        obs.event("serving.request", payload, level=level)
+
+    def _expire_deadlines(self, now: float) -> int:
+        """Expire every live request past its TTL (checked between decode
+        steps and at admission, never inside a compiled step): partial
+        tokens are kept, every page returns, and the terminal state is
+        distinct from abort so clients can tell 'too slow' from
+        'cancelled'. Returns requests expired."""
+        expired = [r for r in self._running + self._waiting
+                   if r.deadline_t is not None and now > r.deadline_t]
+        for req in expired:
+            self._terminate(req, DEADLINE_EXCEEDED, "deadline_exceeded",
+                            extra={"overrun_s":
+                                   round(now - req.deadline_t, 6)})
+        return len(expired)
+
+    def _overload_signals(self) -> dict:
+        """The overload triggers currently tripped ({} = healthy): pool
+        occupancy and waiting-queue depth read directly, p99 TTFT through
+        the SloMonitor so the breach is also counted/evented on the slo.*
+        series. Disabled floors (<= 0) never trip."""
+        sig: dict = {}
+        if self.shed_occupancy > 0:
+            occ = self.pool.pages_in_use / self.pool.num_pages
+            if occ >= self.shed_occupancy:
+                sig["occupancy"] = round(occ, 4)
+        if (self.shed_queue_depth > 0
+                and len(self._waiting) >= self.shed_queue_depth):
+            sig["queue_depth"] = len(self._waiting)
+        if self._slo is not None:
+            for b in self._slo.observe():
+                if b["rule"] == "serving_ttft_p99":
+                    sig["ttft_p99_s"] = round(float(b["value"]), 6)
+        return sig
+
+    def _shed_one(self, max_priority: int | None = None) -> bool:
+        """Shed ONE waiting request: the lowest priority class, youngest
+        arrival within it (it has lost the least). `max_priority`
+        restricts victims to classes strictly below it — a submit never
+        sheds its own class to make room for itself."""
+        cands = (self._waiting if max_priority is None
+                 else [r for r in self._waiting
+                       if r.priority < max_priority])
+        if not cands:
+            return False
+        victim = min(cands, key=lambda r: (r.priority, -r.arrival_t))
+        self._terminate(victim, SHED, "shed",
+                        extra={"priority": victim.priority})
+        return True
+
+    def _update_ladder(self) -> None:
+        """Graceful degradation under sustained pressure (the StepGuard
+        ladder's serving twin): one rung up per `degrade_after`
+        consecutive overloaded steps, one rung down per equally long calm
+        streak. Rungs: 1 speculative decode off, 2 admission stops
+        reserving the decode-lookahead page, 3 the prefix-cache LRU tail
+        is evicted each pressured step, 4 lowest-priority waiters shed."""
+        sig = self._overload_signals()
+        if sig:
+            self._pressure_steps += 1
+            self._calm_steps = 0
+            if (self._ladder_rung < 4
+                    and self._pressure_steps >= self.degrade_after):
+                self._pressure_steps = 0
+                self._ladder_rung += 1
+                name = _LADDER_RUNGS[self._ladder_rung]
+                self._count("ladder." + name)
+                obs.gauge_set("serving.ladder_rung", self._ladder_rung)
+                obs.event("serving.degrade",
+                          {"rung": self._ladder_rung, "name": name,
+                           "direction": "up", "signals": sig},
+                          level="warning")
+        else:
+            self._calm_steps += 1
+            self._pressure_steps = 0
+            if (self._ladder_rung > 0
+                    and self._calm_steps >= self.degrade_after):
+                self._calm_steps = 0
+                self._ladder_rung -= 1
+                obs.gauge_set("serving.ladder_rung", self._ladder_rung)
+                obs.event("serving.degrade",
+                          {"rung": self._ladder_rung, "direction": "down"})
+        if sig and self._ladder_rung >= 3 and self.prefix_cache is not None:
+            self.prefix_cache.evict(1)
+        if sig and self._ladder_rung >= 4:
+            self._shed_one()
+
+    # -- supervision: retried dispatch, invariant audit, recovery -----------
+    def _dispatch(self, kind: str, target, feed, fetch_list):
+        """Every compiled prefill/decode/window/COW step dispatches here:
+        the serving_step_fail fault site, then the executor, under the
+        serving RetryPolicy. Retrying a step is safe — the compiled
+        programs write fixed KV slots derived from the feed, so attempt
+        N+1 overwrites attempt N's partial effects exactly. Retry
+        exhaustion raises _StepFailure; step() turns it into the recovery
+        pass."""
+        def attempt():
+            fault_point("serving_step_fail")
+            return self._exe.run(target, feed=feed, fetch_list=fetch_list,
+                                 scope=self._scope)
+
+        def on_retry(n, exc):
+            self._count("step_retries")
+            obs.event("serving.step_retry",
+                      {"kind": kind, "attempt": n, "error": repr(exc)},
+                      level="warning")
+
+        try:
+            return self._retry.call(attempt, on_retry=on_retry)
+        except self._retry.retryable as e:
+            raise _StepFailure(kind, e) from e
+
+    def _corrupt_pool(self, hit: int) -> None:
+        """The serving_pool_corrupt payload: vandalize ONE piece of
+        host-side bookkeeping so the audit has something real to catch —
+        a phantom refcount holder, a live page pushed back on the free
+        list, or a duplicate ordinal in the newest running request's
+        table (that request is poisoned and must be quarantined). The
+        kind cycles with the fault's hit index; no-op when nothing is
+        live."""
+        in_use = [p for p in range(self.pool.num_pages)
+                  if self.pool.refcount(p) > 0]
+        kind = hit % 3
+        if kind == 0 and in_use:
+            self.pool._refs[in_use[0]] += 1
+        elif kind == 1 and in_use:
+            self.pool._free.append(in_use[0])
+        elif kind == 2:
+            live = [r for r in self._running if r.pages]
+            if live:
+                victim = max(live, key=lambda r: r.admit_seq)
+                victim.pages.append(victim.pages[0])
+
+    def audit_pool(self) -> tuple[list[str], list[int]]:
+        """Cross-check every live page table and the prefix cache against
+        the pool invariants (free list and mapped ordinals partition the
+        pool; refcounts equal live holder counts). Returns (problems,
+        poisoned_rids): a request whose OWN table is malformed —
+        out-of-range or duplicate ordinals — is poisoned, and recovery
+        quarantines it instead of replaying it."""
+        problems: list[str] = []
+        poisoned: list[int] = []
+        holders: dict[int, int] = {}
+        for r in self.requests.values():
+            if not r.pages or r.state in _TERMINAL:
+                continue
+            bad = False
+            seen: set[int] = set()
+            for p in r.pages:
+                if not (0 <= p < self.pool.num_pages):
+                    problems.append(f"request {r.rid} maps page {p} "
+                                    f"outside the pool")
+                    bad = True
+                    continue
+                if p in seen:
+                    problems.append(f"request {r.rid} maps page {p} twice")
+                    bad = True
+                seen.add(p)
+                holders[p] = holders.get(p, 0) + 1
+            if bad:
+                poisoned.append(r.rid)
+        if self.prefix_cache is not None:
+            for node in self.prefix_cache._nodes.values():
+                holders[node.page] = holders.get(node.page, 0) + 1
+        problems.extend(self.pool.check_consistency(holders))
+        return problems, poisoned
+
+    def _recover(self, reason: str, poisoned=(), problems=()) -> None:
+        """The recovery pass: quarantine poisoned requests (their tables
+        are garbage), drop every page table and the whole prefix-cache
+        index, rebuild the pool pristine, and replay every survivor from
+        its PROMPT. Greedy decoding is deterministic, so the replayed
+        outputs are bitwise-equal to a fault-free run (the oracle test's
+        contract); sampled requests re-derive the same tokens through the
+        per-(seed, rid, position) rng."""
+        self._count("recovery.passes")
+        obs.event("serving.recovery",
+                  {"reason": reason, "problems": list(problems)[:8],
+                   "quarantined": list(poisoned),
+                   "running": len(self._running),
+                   "waiting": len(self._waiting)}, level="error")
+        for rid in poisoned:
+            req = self.requests.get(rid)
+            if req is None or req.state in _TERMINAL:
+                continue
+            if req in self._waiting:
+                self._waiting.remove(req)
+            if req in self._running:
+                self._running.remove(req)
+            req.pages = []  # garbage table; the pool rebuild reclaims it
+            req.cached_len = 0
+            req.state = ABORTED
+            req.t_done = time.perf_counter()
+            self._count("recovery.quarantined")
+            obs.event("serving.request",
+                      {"rid": req.rid, "phase": "quarantined",
+                       "n_generated": req.n_generated}, level="error")
+        survivors = sorted(self._running, key=lambda r: r.admit_seq)
+        self._running = []
+        for req in survivors:
+            del req.all_tokens[req.prompt_len:]  # replay from the prompt
+            req.pages = []
+            req.cached_len = 0
+            req.state = WAITING
+            req.admit_seq = -1
+            self._count("recovery.replayed")
+        for req in self._waiting:
+            req.pages = []  # admission pins die with the pool rebuild
+            req.cached_len = 0
+        self._waiting[:0] = survivors
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        self.pool.reset()
+        post, _ = self.audit_pool()
+        if post:
+            raise RuntimeError(
+                f"recovery left the pool inconsistent: {post[:4]}")
+
     def _admit(self) -> int:
         """Admit waiting requests in policy order until pages or inflight
         slots run out. Head-of-line backpressure: the first request that
@@ -541,24 +948,42 @@ class ServingEngine:
         for req in self.scheduler.order(self._waiting):
             if len(self._running) >= self.max_inflight:
                 break
-            matched: list[int] = []
-            if self.prefix_cache is not None:
-                self._count("prefix_lookups")
-                matched = self.prefix_cache.match(
-                    req.all_tokens[:req.prompt_len])
-                # pin the hit BEFORE allocating: the cache's own ref may be
-                # these pages' only holder, and _allocate's eviction relief
-                # under pool pressure could otherwise free the matched pages
-                # and hand them right back as this request's PRIVATE pages
-                # (one physical page mapped at two ordinals)
-                if matched:
-                    self.pool.share(matched)
-            # +1: the decode step after prefill writes one more slot
-            need = self.pool.pages_for(len(req.all_tokens) + 1)
-            private = self._allocate(need - len(matched))
+            if req.deadline_t is not None \
+                    and time.perf_counter() > req.deadline_t:
+                # expired while WAITING: never admit, return any pin
+                self._terminate(req, DEADLINE_EXCEEDED, "deadline_exceeded")
+                continue
+            if req.pages:
+                # a previous attempt already pinned this prefix hit; the
+                # pin persisted across the failed admission so eviction
+                # relief could not free the match out from under the waiter
+                matched = req.pages
+            else:
+                matched = []
+                if self.prefix_cache is not None:
+                    self._count("prefix_lookups")
+                    matched = self.prefix_cache.match(
+                        req.all_tokens[:req.prompt_len])
+                    # pin the hit BEFORE allocating: the cache's own ref
+                    # may be these pages' only holder, and _allocate's
+                    # eviction relief under pool pressure could otherwise
+                    # free the matched pages and hand them right back as
+                    # this request's PRIVATE pages (one physical page
+                    # mapped at two ordinals)
+                    if matched:
+                        self.pool.share(matched)
+            # +1: the decode step after prefill writes one more slot (the
+            # ladder's lookahead-shrink rung drops the reservation to the
+            # bare context; _ensure_writable then allocates on demand)
+            lookahead = 0 if self._ladder_rung >= 2 else 1
+            need = self.pool.pages_for(len(req.all_tokens) + lookahead)
+            private = self._allocate(max(0, need - len(matched)))
             if private is None:
-                if matched:
-                    self.pool.release(matched)
+                # keep the pin on the request: abort/shed/deadline release
+                # it through _terminate, and the next attempt starts with
+                # the shared pages already held
+                req.pages = matched
+                req.cached_len = len(matched) * self.page_size
                 break
             req.pages = matched + private
             req.cached_len = len(matched) * self.page_size
@@ -621,11 +1046,10 @@ class ServingEngine:
                     sv_model.START_FEED: np.asarray([req.cached_len],
                                                     np.int32),
                     sv_model.LEN_FEED: np.asarray([suf], np.int32)}
-            nxt, lg = self._exe.run(
-                self._window_run, feed=feed,
-                fetch_list=[self._window_io["next_token"],
-                            self._window_io["last_logits"]],
-                scope=self._scope)
+            nxt, lg = self._dispatch(
+                "suffix_prefill", self._window_run, feed,
+                [self._window_io["next_token"],
+                 self._window_io["last_logits"]])
             self.stats["prefill_signatures"].add(("suffix", sb, pb))
             self._count("prefill_tokens_computed", suf)
         else:
@@ -640,11 +1064,10 @@ class ServingEngine:
             feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
                     sv_model.PAGES_FEED: pages,
                     sv_model.LEN_FEED: np.asarray([n], np.int32)}
-            nxt, lg = self._exe.run(
-                self._prefill_run, feed=feed,
-                fetch_list=[self._prefill_io["next_token"],
-                            self._prefill_io["last_logits"]],
-                scope=self._scope)
+            nxt, lg = self._dispatch(
+                "prefill", self._prefill_run, feed,
+                [self._prefill_io["next_token"],
+                 self._prefill_io["last_logits"]])
             self.stats["prefill_signatures"].add((sb, pb))
             self._count("prefill_tokens_computed", n)
         self._count("prefills")
@@ -699,10 +1122,9 @@ class ServingEngine:
                 return False
             new = self._allocate(1)
         old = req.pages[ordinal]
-        self._exe.run(self._cow_run, feed={
+        self._dispatch("cow", self._cow_run, {
             sv_model.COW_SRC_FEED: np.asarray([old], np.int32),
-            sv_model.COW_DST_FEED: np.asarray([new[0]], np.int32)},
-            fetch_list=[], scope=self._scope)
+            sv_model.COW_DST_FEED: np.asarray([new[0]], np.int32)}, [])
         self.pool.release([old])
         req.pages[ordinal] = new[0]
         self._count("cow_copies")
@@ -763,7 +1185,10 @@ class ServingEngine:
         self._waiting.insert(0, req)
 
     def _decode_once(self) -> bool:
-        if self.draft_k > 0:
+        # ladder rung 1+ falls back to plain one-token decode: the verify
+        # window is the most speculative compute in the engine, so it is
+        # the first thing sustained overload switches off
+        if self.draft_k > 0 and self._ladder_rung < 1:
             return self._decode_spec()
         self._ensure_writable(0)
         rows = [r for r in self._running if r.state == RUNNING]
@@ -782,11 +1207,9 @@ class ServingEngine:
             mask[i, 0] = 1.0
         feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
                 sv_model.PAGES_FEED: pages, sv_model.MASK_FEED: mask}
-        nxt, lg = self._exe.run(
-            self._decode_run, feed=feed,
-            fetch_list=[self._decode_io["next_token"],
-                        self._decode_io["logits"]],
-            scope=self._scope)
+        nxt, lg = self._dispatch(
+            "decode", self._decode_run, feed,
+            [self._decode_io["next_token"], self._decode_io["logits"]])
         nxt = np.asarray(nxt).reshape(-1)
         self._count("decode_steps")
         self.stats["decode_signatures"].add((bb, pb))
@@ -841,11 +1264,9 @@ class ServingEngine:
         feed = {sv_model.TOK_FEED: tok, sv_model.POS_FEED: pos,
                 sv_model.PAGES_FEED: pages, sv_model.START_FEED: start,
                 sv_model.LEN_FEED: lens}
-        toks, lg = self._exe.run(
-            self._window_run, feed=feed,
-            fetch_list=[self._window_io["tokens"],
-                        self._window_io["logits"]],
-            scope=self._scope)
+        toks, lg = self._dispatch(
+            "verify_window", self._window_run, feed,
+            [self._window_io["tokens"], self._window_io["logits"]])
         toks = np.asarray(toks)
         self._count("decode_steps")
         self._count("spec_steps")

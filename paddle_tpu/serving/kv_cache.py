@@ -165,6 +165,62 @@ class PagedKVPool:
         """Single-holder spelling of `release` (the PR 7 API)."""
         self.release(pages)
 
+    # -- invariant audit (ISSUE 14) -------------------------------------------
+    def check_consistency(self,
+                          holders: "dict[int, int] | None" = None
+                          ) -> list[str]:
+        """Audit the pool invariants; returns the violations found ([] =
+        clean). The two invariants every allocate/share/release must
+        preserve:
+
+          * the free list and the mapped pages PARTITION the pool: every
+            page is either on the free list with refcount 0 or off it with
+            refcount > 0, exactly once;
+          * with `holders` (page id -> how many live page-table/cache
+            entries map it, built by the engine), each page's refcount
+            equals its holder count — a phantom holder pins HBM forever, a
+            missing one frees a page someone still reads.
+
+        Pure read; the recovery pass runs it before and after a rebuild."""
+        problems: list[str] = []
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            dupes = sorted({p for p in self._free if self._free.count(p) > 1})
+            problems.append(f"free list holds duplicate entries {dupes[:8]}")
+        for p in sorted(free_set):
+            if not (0 <= p < self.num_pages):
+                problems.append(f"free list holds page {p} outside the pool "
+                                f"[0, {self.num_pages})")
+            elif self._refs[p] != 0:
+                problems.append(f"page {p} is on the free list with "
+                                f"refcount {self._refs[p]}")
+        for p in range(self.num_pages):
+            r = self._refs[p]
+            if r < 0:
+                problems.append(f"page {p} has negative refcount {r}")
+            elif r == 0 and p not in free_set:
+                problems.append(f"page {p} has refcount 0 but is missing "
+                                f"from the free list")
+        if holders is not None:
+            for p in range(self.num_pages):
+                h = holders.get(p, 0)
+                if self._refs[p] > 0 and self._refs[p] != h:
+                    problems.append(f"page {p} refcount {self._refs[p]} != "
+                                    f"{h} live holders")
+                elif self._refs[p] == 0 and h:
+                    problems.append(f"page {p} is free but {h} live holders "
+                                    f"still map it")
+        return problems
+
+    def reset(self) -> None:
+        """Rebuild the pristine state: every page free at refcount 0 — the
+        recovery pass's pool rebuild. The caller must drop every page table
+        and prefix-cache entry FIRST (their page ids are garbage after
+        this); the device pools need no touch, replayed prefills overwrite
+        them."""
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._refs = [0] * self.num_pages
+
 
 class _PrefixNode:
     __slots__ = ("nid", "page", "key", "parent_id", "children", "last_use")
@@ -310,6 +366,18 @@ class PrefixCache:
                 heapq.heappush(self._heap, (parent.last_use, parent.nid))
         self.pool.release([node.page])
         self.evicted_pages += 1
+
+    def clear(self) -> int:
+        """Drop the WHOLE index without releasing any page (recovery path:
+        the pool underneath is about to be rebuilt, so the cache's
+        refcounts no longer mean anything — releasing them would double-
+        mutate state the rebuild resets anyway). Returns entries dropped.
+        Use `flush` everywhere else."""
+        n = len(self._nodes)
+        self._nodes.clear()
+        self._by_id.clear()
+        self._heap.clear()
+        return n
 
     def flush(self) -> int:
         """Evict every evictable entry (end-of-run accounting / tests):

@@ -1,0 +1,304 @@
+"""Serving-resilience tests (ISSUE 14): request deadlines, overload
+shedding + priority classes, the graceful-degradation ladder, supervised
+dispatch (retry absorption), and exact crash recovery (quarantine + pool
+rebuild + prompt replay, bitwise-equal to a fault-free run under greedy).
+Plus the PagedKVPool invariant audit and the WAITING-abort admission-pin
+regression."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.resilience.faults import fault_scope
+from paddle_tpu.serving import (AdmissionRejected, PagedKVPool,
+                                ServingEngine, decoder_tiny)
+
+
+def _prompt(seed: int, n: int) -> list:
+    return np.random.default_rng(seed).integers(1, 97, n).tolist()
+
+
+def _engine(**kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 64)
+    kw.setdefault("max_inflight", 4)
+    kw.setdefault("draft_k", 0)
+    return ServingEngine(decoder_tiny(), **kw)
+
+
+# -- pool invariant audit (PagedKVPool.check_consistency / reset) ------------
+
+def test_check_consistency_clean_and_each_corruption_kind():
+    pool = PagedKVPool(num_pages=8, page_size=4)
+    pages = pool.allocate(3)
+    assert pool.check_consistency() == []
+    assert pool.check_consistency(holders={p: 1 for p in pages}) == []
+
+    # phantom holder: refcount says 2, the holder map says 1
+    pool._refs[pages[0]] += 1
+    assert pool.check_consistency(holders={p: 1 for p in pages})
+    pool._refs[pages[0]] -= 1
+
+    # live page pushed back on the free list
+    pool._free.append(pages[1])
+    assert pool.check_consistency()
+    pool._free.pop()
+
+    # duplicate free-list entry
+    pool._free.append(pool._free[-1])
+    assert pool.check_consistency()
+    pool._free.pop()
+
+    assert pool.check_consistency() == []
+    pool.reset()
+    assert pool.free_count == pool.num_pages
+    assert pool.check_consistency() == []
+
+
+# -- deadlines: WAITING / mid-decode / crossing the first step ---------------
+
+def test_deadline_expires_while_waiting():
+    obs.reset("serving.")
+    eng = _engine()
+    rid = eng.submit(_prompt(0, 5), 4, deadline_s=1e-4)
+    time.sleep(0.01)
+    eng.step()  # top-of-step expiry fires before admission
+    req = eng.requests[rid]
+    assert req.state == "deadline_exceeded"
+    assert req.pages == [] and req.n_generated == 0
+    assert eng.stats["deadline_exceeded"] == 1
+    assert obs.snapshot()["counters"].get("serving.deadline_exceeded") == 1
+    assert not eng.has_work()
+    assert eng.leaked_pages() == 0
+    assert eng.pop_result(rid) == []
+
+
+def test_deadline_expires_mid_decode_keeps_partial_tokens():
+    eng = _engine()
+    rid = eng.submit(_prompt(1, 5), 8)
+    eng.step()  # admit + prefill + first decode
+    req = eng.requests[rid]
+    assert req.state == "running" and req.n_generated >= 1
+    req.deadline_t = time.perf_counter() - 1.0
+    eng.step()
+    assert req.state == "deadline_exceeded"
+    assert req.pages == [], "expiry must return every page"
+    assert 1 <= req.n_generated < 8, "partial output is kept"
+    assert eng.stats["deadline_exceeded"] == 1
+    assert eng.leaked_pages() == 0
+    eng.flush_prefix_cache()
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
+def test_deadline_crossing_inside_first_step_caught_same_step():
+    """A TTL that expires DURING the admission/prefill/decode span is
+    caught by the post-decode sweep in the same scheduler step — pages
+    release immediately, not one iteration later."""
+    eng = _engine()
+    rid = eng.submit(_prompt(2, 6), 4)
+    # generous vs the pre-admission check, tiny vs the first step's XLA
+    # compile (hundreds of ms on CPU)
+    eng.requests[rid].deadline_t = time.perf_counter() + 0.02
+    eng.step()
+    req = eng.requests[rid]
+    assert req.state == "deadline_exceeded"
+    assert req.pages == []
+    assert eng.stats["deadline_exceeded"] == 1
+    assert eng.leaked_pages() == 0
+
+
+# -- satellite: aborting a WAITING request releases its admission pin --------
+
+def test_abort_waiting_request_releases_prefix_pin():
+    """A failed admission attempt leaves the matched prefix-cache pages
+    PINNED on the waiting request (so eviction relief cannot free the
+    match). abort() of that WAITING request must release the pin — the
+    leak the pre-ISSUE-14 abort (waiting-queue removal only) had."""
+    eng = _engine(pool_pages=16, prefix_cache=True)
+    sysp = _prompt(3, 8)  # two full pages: prefix-cache territory
+    a = eng.submit(sysp, 2)
+    eng.run_until_drained()
+    assert eng.requests[a].state == "finished"
+    cache_pages = [n.page for n in eng.prefix_cache._nodes.values()]
+    assert len(cache_pages) == 2
+
+    # r outlives the next step (prefill emits token 1, one decode per
+    # step) and its admission grant of pages_for(5+1)=2 pages covers all
+    # 8 final slots, so it never needs the starved pool again
+    r = eng.submit(_prompt(4, 5), 3)
+    eng.step()  # admit + prefill + first decode: r keeps running
+    hold = eng.pool.allocate(eng.pool.free_count)  # starve the pool
+    assert hold is not None
+
+    b = eng.submit(sysp + _prompt(5, 4), 2)
+    eng.step()  # admission matches the cached prefix, private alloc fails
+    breq = eng.requests[b]
+    assert breq.state == "waiting"
+    assert sorted(breq.pages) == sorted(cache_pages), "pin not recorded"
+    assert all(eng.pool.refcount(p) == 2 for p in cache_pages)
+
+    eng.abort(b)
+    assert breq.state == "aborted" and breq.pages == []
+    assert all(eng.pool.refcount(p) == 1 for p in cache_pages), (
+        "abort of a WAITING request must release its admission pin")
+
+    eng.pool.release(hold)
+    eng.run_until_drained()
+    assert eng.requests[r].state == "finished"
+    assert eng.leaked_pages() == 0
+    eng.flush_prefix_cache()
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
+# -- admission control: priority shedding + reject-with-retry-after ----------
+
+def test_admission_rejects_and_sheds_by_priority():
+    eng = _engine(max_inflight=1, shed_queue_depth=2)
+    a = eng.submit(_prompt(6, 4), 2, priority=0)
+    b = eng.submit(_prompt(7, 4), 2, priority=0)
+
+    # same class: nothing strictly lower to shed -> explicit refusal
+    with pytest.raises(AdmissionRejected) as ei:
+        eng.submit(_prompt(8, 4), 2, priority=0)
+    assert "queue_depth" in ei.value.signals
+    assert ei.value.retry_after_s > 0
+    assert eng.stats["rejects"] == 1
+
+    # higher class: sheds the youngest lowest-priority waiter (b) instead
+    d = eng.submit(_prompt(9, 4), 2, priority=5)
+    assert eng.requests[b].state == "shed"
+    assert eng.stats["shed"] == 1
+    assert eng.pop_result(b) == []
+
+    eng.run_until_drained()
+    assert eng.requests[a].state == "finished"
+    assert eng.requests[d].state == "finished"
+    assert eng.leaked_pages() == 0
+
+
+# -- the graceful-degradation ladder -----------------------------------------
+
+def test_ladder_climbs_under_pressure_and_descends_calm():
+    """Occupancy pressure climbs the ladder one rung per `degrade_after`
+    pressured steps (each rung counted), rung 4 sheds waiters; a calm
+    streak of the same length walks it back down to nominal."""
+    eng = _engine(pool_pages=8, max_inflight=2, prefix_cache=False,
+                  shed_occupancy=0.3, degrade_after=1)
+    # long enough to span several steps: two running requests hold 4-6 of
+    # the 8 pages, so the occupancy floor stays tripped between steps
+    rids = [eng.submit(_prompt(10 + i, 3), 6) for i in range(6)]
+    eng.run_until_drained()
+    for rung in ("spec_off", "lookahead_shrink", "cache_evict", "shed"):
+        assert eng.stats["ladder." + rung] >= 1, f"rung {rung} never hit"
+    assert eng.stats["shed"] >= 1, "rung 4 shed no waiter"
+    states = {eng.requests[r].state for r in rids}
+    assert states <= {"finished", "shed"}
+    assert "finished" in states
+    assert eng.leaked_pages() == 0
+    # idle steps: occupancy is back to zero, the ladder walks down
+    for _ in range(8):
+        eng.step()
+    assert eng._ladder_rung == 0
+
+
+# -- supervision: retry absorption + exact recovery --------------------------
+
+def _drain_outputs(eng, seeds, max_new=4):
+    rids = [eng.submit(_prompt(s, 5), max_new) for s in seeds]
+    eng.run_until_drained()
+    return {i: eng.requests[r].out_tokens for i, r in enumerate(rids)}, rids
+
+
+def test_transient_step_faults_absorbed_by_retry():
+    """Isolated dispatch faults (hits 3 and 7 — different dispatches) are
+    absorbed by the retry policy: outputs bitwise-equal to fault-free, no
+    recovery pass."""
+    seeds = (20, 21, 22)
+    want, _ = _drain_outputs(_engine(prefix_cache=False, seed=0), seeds)
+    eng = _engine(prefix_cache=False, seed=0, step_retries=3)
+    with fault_scope("serving_step_fail:3,7") as plan:
+        got, _ = _drain_outputs(eng, seeds)
+        assert plan.stats()["fired"]
+    assert got == want
+    assert eng.stats["step_retries"] == 2
+    assert eng.stats["recovery.passes"] == 0
+    assert eng.leaked_pages() == 0
+
+
+def test_recovery_oracle_step_fail_exhaustion():
+    """Hits 5,6,7 burn every attempt of ONE dispatch: the supervisor runs
+    a recovery pass (pool rebuild + prompt replay) and the final outputs
+    are STILL bitwise-equal to the fault-free run — greedy decode is
+    deterministic, so replay-from-prompt is exact."""
+    seeds = (30, 31, 32)
+    want, _ = _drain_outputs(_engine(prefix_cache=False, seed=0), seeds)
+    eng = _engine(prefix_cache=False, seed=0, step_retries=3)
+    with fault_scope("serving_step_fail:5,6,7") as plan:
+        got, _ = _drain_outputs(eng, seeds)
+        assert plan.stats()["fired"]
+    assert got == want, "recovery replay diverged from the fault-free run"
+    assert eng.stats["recovery.passes"] == 1
+    assert eng.stats["recovery.replayed"] >= 1
+    assert eng.stats["recovery.quarantined"] == 0
+    assert eng.leaked_pages() == 0
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
+def test_recovery_quarantines_poisoned_request():
+    """Corruption kind 2 (duplicate ordinal in the newest running page
+    table) poisons that request: the per-step audit catches it, recovery
+    quarantines it (aborted, pages forfeited) and replays the others to
+    fault-free-identical outputs over a rebuilt pool."""
+    seeds = (40, 41, 42)
+    want, _ = _drain_outputs(_engine(prefix_cache=False, seed=0), seeds)
+    eng = _engine(prefix_cache=False, seed=0, audit_every=1)
+    with fault_scope("serving_pool_corrupt:2") as plan:
+        got, rids = _drain_outputs(eng, seeds)
+        assert plan.stats()["fired"]
+    assert eng.stats["recovery.passes"] == 1
+    assert eng.stats["recovery.quarantined"] == 1
+    quarantined = [i for i, r in enumerate(rids)
+                   if eng.requests[r].state == "aborted"]
+    assert len(quarantined) == 1
+    for i, r in enumerate(rids):
+        if i in quarantined:
+            continue
+        assert eng.requests[r].state == "finished"
+        assert got[i] == want[i], f"survivor {i} diverged after recovery"
+    problems, _ = eng.audit_pool()
+    assert problems == []
+    assert eng.leaked_pages() == 0
+    assert eng.pool.free_count == eng.pool.num_pages
+
+
+def test_recovery_from_refcount_corruption_replays_all():
+    """Corruption kind 0 (phantom refcount holder) dirties the pool audit
+    without poisoning any page table: recovery replays EVERY live request
+    and quarantines none."""
+    seeds = (50, 51)
+    want, _ = _drain_outputs(_engine(prefix_cache=False, seed=0), seeds)
+    eng = _engine(prefix_cache=False, seed=0, audit_every=1)
+    with fault_scope("serving_pool_corrupt:3") as plan:  # hit 3 -> kind 0
+        got, rids = _drain_outputs(eng, seeds)
+        assert plan.stats()["fired"]
+    assert eng.stats["recovery.passes"] == 1
+    assert eng.stats["recovery.quarantined"] == 0
+    assert all(eng.requests[r].state == "finished" for r in rids)
+    assert got == want
+    assert eng.leaked_pages() == 0
+
+
+# -- chaos: the serving drill (tools/chaos.py --serve) ------------------------
+
+@pytest.mark.chaos
+def test_serve_drill_survives_random_fault_plans():
+    """The tools/chaos.py --serve drill, small: random plans over all
+    three serving fault sites; the drill itself asserts clean terminal
+    states, a clean pool audit and zero leaks every cycle."""
+    from tools.chaos import run_serve_drill
+
+    out = run_serve_drill(cycles=2, n_req=4, p=0.12, seed=3)
+    fired = [f for c in out["cycles"] for f in c["fired"]]
+    assert fired, "the random plans never fired a fault"
+    assert out["leaked_pages"] == 0

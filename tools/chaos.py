@@ -198,6 +198,61 @@ def run_numeric_smoke(steps: int = 8, seed: int = 0) -> dict:
             "final_loss": final, "events": guard.events}
 
 
+def run_serve_drill(cycles: int = 3, n_req: int = 6, p: float = 0.08,
+                    seed: int = 0, verbose: bool = False) -> dict:
+    """Serving-resilience drill (ISSUE 14): drive the continuous-batching
+    engine through `cycles` open-loop waves of requests under a seeded
+    `rand:` plan over the three serving fault sites (step-fail at every
+    compiled dispatch, pool-bookkeeping corruption, deadline collapse).
+    Every cycle must drain with ZERO page/refcount leaks, a clean
+    PagedKVPool.check_consistency audit, and every request in a clean
+    terminal state — the engine absorbs isolated faults via retry and
+    recovers from the rest via quarantine + pool rebuild + prompt replay.
+    Returns per-cycle fired faults and terminal-state tallies."""
+    from paddle_tpu.resilience import fault_scope
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving import model as sv_model
+
+    eng = ServingEngine(sv_model.decoder_tiny(), page_size=4, pool_pages=64,
+                        max_inflight=4, seed=seed, prefix_cache=True,
+                        draft_k=0, audit_every=1, step_retries=2)
+    rng = np.random.default_rng(seed)
+    clean_terminal = ("finished", "aborted", "deadline_exceeded", "shed")
+    cycles_out = []
+    for cycle in range(cycles):
+        rids = [eng.submit(rng.integers(
+                    1, eng.cfg.vocab_size,
+                    size=int(rng.integers(3, 9))).tolist(),
+                    int(rng.integers(2, 6)))
+                for _ in range(n_req)]
+        plan = (f"rand:p={p},seed={seed * 101 + cycle},max=8,"
+                f"sites=serving_step_fail|serving_pool_corrupt|"
+                f"serving_deadline")
+        with fault_scope(plan) as fp:
+            eng.run_until_drained()
+            fired = list(fp.stats()["fired"])
+        states = {rid: eng.requests[rid].state for rid in rids}
+        bad = {r: s for r, s in states.items() if s not in clean_terminal}
+        assert not bad, f"cycle {cycle}: unclean terminal states {bad}"
+        problems, _ = eng.audit_pool()
+        assert not problems, f"cycle {cycle}: dirty pool audit {problems}"
+        leaked = eng.leaked_pages()
+        assert leaked == 0, f"cycle {cycle}: leaked {leaked} pages"
+        tally: dict = {}
+        for s in states.values():
+            tally[s] = tally.get(s, 0) + 1
+        if verbose:
+            print(f"cycle {cycle}: fired={fired} states={tally}")
+        cycles_out.append({"plan": plan, "fired": fired, "states": tally})
+        eng.prune_finished()
+    snap = eng.stats_snapshot()
+    return {"cycles": cycles_out,
+            "recovery_passes": snap["recovery.passes"],
+            "step_retries": snap["step_retries"],
+            "deadline_exceeded": snap["deadline_exceeded"],
+            "leaked_pages": snap["leaked_pages"]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -218,7 +273,27 @@ def main(argv=None) -> int:
                     help="run the numeric-guardrail drill (seeded "
                          "numeric_nan/numeric_spike under "
                          "FLAGS_guard_numerics)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving-resilience drill: rand-plan "
+                         "faults over serving_step_fail / "
+                         "serving_pool_corrupt / serving_deadline; every "
+                         "cycle must drain leak-free with a clean pool "
+                         "audit and clean terminal states")
     args = ap.parse_args(argv)
+
+    if args.serve:
+        try:
+            out = run_serve_drill(p=args.p or 0.08, seed=args.seed,
+                                  verbose=True)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"SERVE DRILL FAILED: {e}", file=sys.stderr)
+            return 1
+        fired = sum(len(c["fired"]) for c in out["cycles"])
+        print(f"OK: served {len(out['cycles'])} cycle(s) through {fired} "
+              f"injected fault(s) — {out['recovery_passes']} recovery "
+              f"pass(es), {out['step_retries']} absorbed retries, "
+              f"{out['deadline_exceeded']} deadline expiries, 0 leaks")
+        return 0
 
     if args.numeric:
         try:

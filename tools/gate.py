@@ -68,6 +68,20 @@ SERVING_TOK_S_DROP = 0.8
 # list after drain + cache flush) are a hard fail at any count in ANY arm.
 PREFIX_HIT_RATE_FLOOR = 0.5
 
+# serving resilience (ISSUE 14): under the 10x overload arm the engine must
+# KEEP its goodput (finished-request tokens/s) by shedding — below this
+# fraction of the unloaded arm's goodput, admission control is thrashing
+# instead of protecting. Same floor for the faulted arm vs the overload
+# arm: supervised recovery (retries, pool rebuild, replay) must cost
+# bounded work, not eat the engine. Leaks hard-fail at any count in ANY
+# arm — shed/expire/recovery are exactly the paths that lose pages.
+OVERLOAD_GOODPUT_FLOOR = 0.7
+# admitted requests' p99 TTFT under overload may not blow past this
+# multiple of the unloaded arm's: shedding exists precisely so the work
+# that IS admitted still sees bounded latency (unbounded queueing is the
+# collapse mode the floors are armed against)
+OVERLOAD_TTFT_CEIL_RATIO = 50.0
+
 # tiered embedding engine (ISSUE 10): parameter parity vs the dense-lookup
 # oracle is a hard correctness invariant — the tiered path is a data-movement
 # refactor, any drift beyond float associativity means a lost update
@@ -334,6 +348,56 @@ def _check_shared_prefix(sv: dict, label: str) -> int:
     return rc
 
 
+def _check_overload(sv: dict, label: str) -> int:
+    """Serving-resilience gate (ISSUE 14) over the three-arm overload
+    block: page/refcount leaks hard-fail in every arm, overload goodput
+    must clear OVERLOAD_GOODPUT_FLOOR of the unloaded arm (and the faulted
+    arm the same floor of the overload arm), and admitted-request p99 TTFT
+    must stay within OVERLOAD_TTFT_CEIL_RATIO of unloaded. Artifacts
+    predating the block are skipped."""
+    ov = sv.get("overload")
+    if not isinstance(ov, dict):
+        return 0
+    rc = 0
+    arms = ov.get("arms") or {}
+    for arm, row in sorted(arms.items()):
+        for field in ("kv_pages_leaked", "refcount_leaks"):
+            n = row.get(field)
+            if n:
+                print(f"[gate] FAIL: overload arm '{arm}' reports "
+                      f"{field}={n} — a shed/expire/recovery path is "
+                      f"freeing or orphaning pages it must not", flush=True)
+                rc = 1
+    g_ratio = ov.get("goodput_vs_unloaded")
+    f_ratio = ov.get("faulted_vs_overload")
+    t_ratio = ov.get("ttft_p99_ratio")
+    print(f"[gate] bench {label}: overload goodput {g_ratio}x unloaded, "
+          f"faulted {f_ratio}x overload, shed rate {ov.get('shed_rate')}, "
+          f"admitted ttft p99 ratio {t_ratio}, recoveries "
+          f"{(arms.get('overload_faulted') or {}).get('recovery_passes')}",
+          flush=True)
+    if g_ratio is not None and g_ratio < OVERLOAD_GOODPUT_FLOOR:
+        print(f"[gate] FAIL: overload goodput is {g_ratio}x the unloaded "
+              f"arm (floor {OVERLOAD_GOODPUT_FLOOR}) — the shed floors / "
+              f"degradation ladder are thrashing the engine instead of "
+              f"protecting it (check shed_rate and ladder_climbs in the "
+              f"block)", flush=True)
+        rc = 1
+    if f_ratio is not None and f_ratio < OVERLOAD_GOODPUT_FLOOR:
+        print(f"[gate] FAIL: the faulted overload arm delivers {f_ratio}x "
+              f"the fault-free overload arm (floor {OVERLOAD_GOODPUT_FLOOR})"
+              f" — supervised recovery (retries, pool rebuild, replay) is "
+              f"costing unbounded work", flush=True)
+        rc = 1
+    if t_ratio is not None and t_ratio > OVERLOAD_TTFT_CEIL_RATIO:
+        print(f"[gate] FAIL: admitted-request p99 TTFT under overload is "
+              f"{t_ratio}x the unloaded arm (ceiling "
+              f"{OVERLOAD_TTFT_CEIL_RATIO}) — admission control is letting "
+              f"the queue collapse instead of shedding", flush=True)
+        rc = 1
+    return rc
+
+
 def _check_serving(data: dict, prev_path: str | None, label: str) -> int:
     """Serving-block gate (ISSUE 7): zero KV-page leak is a hard invariant;
     served tokens/s may not drop below SERVING_TOK_S_DROP of the previous
@@ -362,6 +426,9 @@ def _check_serving(data: dict, prev_path: str | None, label: str) -> int:
               flush=True)
         return 1
     rc = _check_shared_prefix(sv, label)
+    if rc:
+        return rc
+    rc = _check_overload(sv, label)
     if rc:
         return rc
     if cur is None or prev_path is None:
