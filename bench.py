@@ -963,10 +963,15 @@ def bench_multichip(argv=None):
 def main():
     from paddle_tpu import flags as pt_flags
     from paddle_tpu import tuning
+    from paddle_tpu.tuning import learned as tuning_learned
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     peak = _peak_flops(dev)
+
+    # learned-tier provenance is per-RUN (gate.py's fallback-rate ceiling
+    # reads the artifact's aggregate), unlike the per-workload hit rates
+    tuning_learned.reset_counters()
 
     tuner_stats: dict = {}
     tok_s, bert_mfu, bert_windows = _tuned(
@@ -983,6 +988,22 @@ def main():
                       on_tpu)
     serving = _tuned(tuner_stats, "serving", bench_serving, on_tpu)
     telemetry = bench_telemetry(on_tpu)
+
+    # bench rounds feed the measurement store too (sweep/explore mode or
+    # FLAGS_tuning_record=on): per-window seconds-per-item rows under the
+    # run's tuning mode as the arm — A/B material for mode-on-vs-off drift
+    def _rec_bench(wl, unit, windows):
+        ws = [1.0 / w for w in windows if w and w > 0]
+        if ws and tuning_learned.recording_enabled():
+            tuning_learned.record(
+                "bench", f"workload={wl}", "-", tuning.device_kind(),
+                f"mode_{tuning.mode()}", windows_s=ws, source="bench",
+                extras={"unit": unit})
+
+    _rec_bench("bert", "s_per_token", bert_windows)
+    _rec_bench("resnet50", "s_per_image", rn_windows)
+    _rec_bench("transformer_wmt", "s_per_token", wmt_windows)
+    _rec_bench("deepfm", "s_per_example", ctr_windows)
 
     # the registry's end-of-run name inventory rides in the artifact:
     # tools/gate.py --obs lints it against observability/schema.py, so a
@@ -1078,6 +1099,10 @@ def main():
         "tuning": {
             "mode": tuning.mode(),
             "db": str(pt_flags.get_flag("tuning_db")),
+            "model": tuning_learned.model_path() or "",
+            # learned-tier aggregate: predictions/fallbacks/promotions +
+            # fallback_rate (gate.py --costmodel's consult-mode ceiling)
+            "learned": tuning_learned.snapshot(),
             "workloads": tuner_stats,
         },
         "config": {

@@ -74,15 +74,44 @@ _define("tuning_mode", "off",
         "framework-wide autotuner (paddle_tpu/tuning/): 'off' keeps every "
         "lever on its pre-tuner logic; 'consult' resolves tunable decisions "
         "(conv lowering, attention backend, conv+BN fusion, AMP gray ops, "
-        "bucket boundaries) through the three-tier policy exact-DB-hit -> "
-        "analytic prior -> conservative default; 'sweep' resolves "
-        "analytically but records every distinct decision key into the DB "
-        "as a candidate so tools/tune.py knows what to measure")
+        "bucket boundaries) through the tier policy exact-DB-hit -> "
+        "learned cost model -> analytic prior -> conservative default; "
+        "'sweep' resolves analytically but records every distinct decision "
+        "key into the DB as a candidate so tools/tune.py knows what to "
+        "measure; 'explore' is consult plus bounded online measurement — "
+        "tuning/learned/explore.py probes one recorded candidate every "
+        "FLAGS_tuning_explore_every executor steps and promotes "
+        "out-of-interference-band verdicts to swept entries")
 _define("tuning_db", "",
         "path of the persistent tuning decision database (schema-versioned "
         "JSON, atomic temp+rename writes; tuning/db.py). Empty = no DB: "
         "consult mode degrades to the analytic priors. A corrupt/missing "
         "file warns once and falls back to analytic — never an error")
+_define("tuning_measurements", "",
+        "path of the append-only JSONL measurement store "
+        "(tuning/learned/store.py) the sweeps, A/B harnesses, bench rounds "
+        "and explore probes append raw per-arm window timings to — the "
+        "learned cost model's training set. Empty = derived from "
+        "FLAGS_tuning_db (<db stem>.measurements.jsonl next to it); with "
+        "no DB either, nothing records")
+_define("tuning_record", "auto",
+        "measurement-store gate (tuning/learned/store.py): 'auto' "
+        "(default) records from the tools (tune.py sweeps, the A/B "
+        "harnesses) whenever a store path resolves but from the runtime "
+        "only under tuning_mode sweep/explore; 'on' always records; 'off' "
+        "never records")
+_define("tuning_model", "",
+        "path of the trained cost-model artifact (tools/costmodel.py "
+        "train; tuning/learned/model.py). Empty = derived from "
+        "FLAGS_tuning_db (<db stem>.model.json next to it). Missing file "
+        "= no learned tier; a corrupt file warns once and the policy "
+        "falls back to the analytic prior — never an error")
+_define("tuning_explore_every", 64,
+        "explore-mode pacing: probe at most one candidate key per this "
+        "many executor steps (tuning/learned/explore.py). Each probe is a "
+        "few tiny timed windows in the async window-drain gap; verdicts "
+        "inside the interference band never overwrite the analytic "
+        "decision. <= 0 disables probing even in explore mode")
 _define("pallas_epilogue", "auto",
         "fused normalize+affine+activation(+residual) epilogue kernels "
         "(ops/pallas_kernels/epilogue.py). 'auto' (default): when "

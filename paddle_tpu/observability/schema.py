@@ -130,8 +130,20 @@ DECLARED: list[tuple] = [
      "watchdog stall dump (what/window/in-flight state)", ()),
     # -- autotuner provenance (tuning/policy.py) ----------------------------
     ("tuning.decisions", COUNTER,
-     "decide() resolutions by (op, tier) — tier in db/analytic/default",
+     "decide() resolutions by (op, tier) — tier in "
+     "db/learned/analytic/default",
      ("op", "tier")),
+    # -- learned cost model (tuning/learned/) -------------------------------
+    ("tuning.learned.predictions", COUNTER,
+     "learned-tier decisions that stood (confidence gates passed, "
+     "validate accepted)", ("op",)),
+    ("tuning.learned.fallbacks", COUNTER,
+     "learned-tier attempts that fell back to the analytic prior, by "
+     "reason (accuracy/envelope/features/feature_drift/validate)",
+     ("op", "reason")),
+    ("tuning.learned.explore_promotions", COUNTER,
+     "explore-mode candidates promoted to swept DB entries by an "
+     "out-of-band online verdict", ("op",)),
     # -- tiered embeddings (embedding/engine.py) ----------------------------
     ("emb.hit_ids", COUNTER,
      "id occurrences served from the hot-ID cache", ("table",)),

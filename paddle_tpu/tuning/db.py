@@ -34,7 +34,27 @@ DB_SCHEMA = 1
 
 __all__ = ["DB_SCHEMA", "TuningDB", "canonical_key", "conv_key",
            "attention_key", "bucket_key", "amp_key", "collective_key",
-           "epilogue_key", "xent_key", "embedding_key"]
+           "epilogue_key", "xent_key", "embedding_key", "evidence"]
+
+
+def evidence(measured: dict) -> dict:
+    """The canonical `measured` block every writer attaches to an entry:
+    {arm: {"median_s": ..., "band": ...}} distilled from full
+    tools/_timing.measure dicts. Offline sweeps (tools/tune.py) and
+    explore-mode promotions (tuning/learned/explore.py) both go through
+    here, so a candidate promoted online carries byte-identical evidence
+    to one swept offline — and a candidate entry that HAS been measured
+    (an in-band tie) keeps its times instead of just the decision."""
+    out = {}
+    for arm in sorted(measured):
+        m = measured[arm]
+        if not isinstance(m, dict) or m.get("median_s") is None:
+            continue
+        e = {"median_s": m["median_s"]}
+        if m.get("band") is not None:
+            e["band"] = m["band"]
+        out[arm] = e
+    return out
 
 
 def canonical_key(op: str, shape_key: str, dtype: str, device_kind: str) -> str:

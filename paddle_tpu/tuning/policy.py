@@ -1,13 +1,15 @@
-"""Decision resolution: exact DB hit -> analytic prior -> conservative default.
+"""Decision resolution: DB hit -> learned model -> analytic -> default.
 
 `decide()` is the one consult point every tunable lever flows through
 (conv lowering, attention backend, conv+BN fusion, AMP list membership,
-bucket boundaries). Three tiers, strictly ordered:
+bucket boundaries). Four tiers, strictly ordered:
 
   1. exact hit  — the swept DB has this (op, shape, dtype, device_kind) key;
-  2. analytic   — the registered prior for the op kind (the PR 5 cost model
+  2. learned    — the trained cost model (tuning/learned/) predicts per-arm
+                  times for this UNSEEN key and its confidence gates pass;
+  3. analytic   — the registered prior for the op kind (the PR 5 cost model
                   for convs, the measured-dispatch rules for attention);
-  3. default    — the caller's conservative fallback (what the code did
+  4. default    — the caller's conservative fallback (what the code did
                   before the tuner existed).
 
 Every resolution bumps a per-op provenance counter so bench.py can report
@@ -20,6 +22,10 @@ Modes (FLAGS_tuning_mode):
   sweep   — resolve analytically like `off`, but RECORD every distinct key
             encountered into the DB as a `candidate` entry (never clobbering
             a swept verdict) so `tools/tune.py` knows what to measure.
+  explore — consult, plus candidate recording, plus bounded ONLINE
+            measurement: tuning/learned/explore.py probes one recorded
+            candidate every FLAGS_tuning_explore_every executor steps and
+            promotes out-of-band verdicts to swept entries (TVM-style).
 """
 from __future__ import annotations
 
@@ -41,11 +47,12 @@ _counters: dict[str, dict[str, int]] = {}
 
 def mode() -> str:
     m = str(flags.get_flag("tuning_mode")).strip().lower()
-    return m if m in ("off", "consult", "sweep") else "off"
+    return m if m in ("off", "consult", "sweep", "explore") else "off"
 
 
 def consult_enabled() -> bool:
-    return mode() == "consult"
+    # explore IS consult (same tier resolution) with online measurement on
+    return mode() in ("consult", "explore")
 
 
 def sweep_enabled() -> bool:
@@ -101,7 +108,7 @@ def invalidate_db_cache() -> None:
 def _bump(op: str, tier: str) -> None:
     with _lock:
         c = _counters.setdefault(op, {"db": 0, "analytic": 0, "default": 0})
-        c[tier] += 1
+        c[tier] = c.get(tier, 0) + 1
     from .. import observability as obs
 
     obs.counter_inc("tuning.decisions", labels={"op": op, "tier": tier})
@@ -113,16 +120,23 @@ def reset_provenance() -> None:
 
 
 def provenance_snapshot() -> dict:
-    """Per-op tier counts plus the aggregate hit-rate bench.py reports:
-    swept-DB resolutions over all resolutions (1.0 = fully tuned)."""
+    """Per-op tier counts plus the aggregate rates bench.py reports:
+    hit_rate is swept-DB resolutions over all resolutions, tuned_rate
+    additionally credits the learned tier (a model prediction IS a
+    measured-data decision, just an interpolated one — gate.py's coverage
+    floor reads tuned_rate so a model-served workload is not flagged as
+    untuned)."""
     with _lock:
         per_op = {op: dict(c) for op, c in _counters.items()}
     total = sum(sum(c.values()) for c in per_op.values())
     hits = sum(c["db"] for c in per_op.values())
+    learned = sum(c.get("learned", 0) for c in per_op.values())
     return {
         "decisions": total,
         "db_hits": hits,
+        "learned": learned,
         "hit_rate": round(hits / total, 4) if total else None,
+        "tuned_rate": round((hits + learned) / total, 4) if total else None,
         "per_op": per_op,
     }
 
@@ -130,18 +144,20 @@ def provenance_snapshot() -> dict:
 def decide(op: str, key: str, prior=None, default: dict | None = None,
            validate=None) -> tuple[dict, str]:
     """Resolve one decision. Returns (decision dict, tier) with tier in
-    {"db", "analytic", "default"}.
+    {"db", "learned", "analytic", "default"}.
 
     `prior`: zero-arg callable returning the analytic decision (evaluated
     lazily — cost models only run on a DB miss). `validate`: optional
-    predicate on a DB decision; a swept entry the current build cannot honor
-    (e.g. a pallas backend off-TPU) falls through to the prior instead of
-    being obeyed blindly. In sweep mode the analytic resolution is recorded
-    as a candidate entry for tools/tune.py."""
+    predicate on a DB or learned decision; a decision the current build
+    cannot honor (e.g. a pallas backend off-TPU) falls through to the prior
+    instead of being obeyed blindly. In sweep mode the analytic resolution
+    is recorded as a candidate entry for tools/tune.py; explore mode records
+    candidates too (food for the online prober) while resolving normally."""
     if sweep_enabled():
         d = _resolve_prior(op, prior, default)
         _record_candidate(key, d)
         return d
+    m = mode()
     db = get_db()
     entry = db.lookup(key)
     if entry is not None and entry.get("source") != "candidate":
@@ -149,7 +165,18 @@ def decide(op: str, key: str, prior=None, default: dict | None = None,
         if validate is None or validate(decision):
             _bump(op, "db")
             return decision, "db"
-    return _resolve_prior(op, prior, default)
+    from . import learned
+
+    ld = learned.decide_learned(op, key, validate)
+    if ld is not None:
+        _bump(op, "learned")
+        if m == "explore" and entry is None:
+            _record_candidate(key, (ld, "learned"))
+        return ld, "learned"
+    res = _resolve_prior(op, prior, default)
+    if m == "explore" and entry is None:
+        _record_candidate(key, res)
+    return res
 
 
 def _resolve_prior(op, prior, default):

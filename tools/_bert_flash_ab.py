@@ -35,6 +35,14 @@ def run(use_flash):
             iters=iters, passes=2, warmup=1)
         (loss,) = exe.run(main_p, feed=feed, fetch_list=[avg_loss])
         assert np.isfinite(float(np.asarray(loss)))
+        from paddle_tpu import tuning
+        from paddle_tpu.tuning.learned import store as learned_store
+        if learned_store.recording_enabled(tool=True):
+            learned_store.record(
+                "ab.bert", f"workload=bert b={batch} s={seq_len}", "-",
+                tuning.device_kind(), f"flash{int(bool(use_flash))}",
+                windows_s=m["windows_s"], median_s=m["median_s"],
+                min_s=m["min_s"], band=m["band"], source="ab")
     dt = m["median_s"]
     tokens = batch * seq_len
     H, L_, F, V = 768, 12, 3072, 30522

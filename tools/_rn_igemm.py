@@ -27,7 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 import bench  # noqa: E402
-from paddle_tpu import flags  # noqa: E402
+from paddle_tpu import flags, tuning  # noqa: E402
+from paddle_tpu.tuning.learned import store as learned_store  # noqa: E402
 from tools import _timing  # noqa: E402
 
 ARMS = {
@@ -49,6 +50,14 @@ def main():
                          "windows_img_s": windows,
                          "band": round(_timing.interference_band(windows), 4)}
         print(json.dumps({"arm": name, **results[name]}), flush=True)
+        if learned_store.recording_enabled(tool=True):
+            # windows are images/s; store seconds-per-image so the record
+            # reads like every other timing row
+            learned_store.record(
+                "ab.resnet50", "workload=resnet50 lever=conv", "-",
+                tuning.device_kind(), name,
+                windows_s=[1.0 / w for w in windows if w > 0],
+                band=results[name]["band"], source="ab")
     base = results["off"]["img_s"]
     # keep-or-retire per arm on the shared verdict rule (tools/_timing.py):
     # seconds-per-image medians, band floored at gate.py's 5%

@@ -520,10 +520,29 @@ def main():
         out = run_open_loop(_mk_engine(cfg, args), wl, warmup=warm)
         out["rate_req_s"] = rate
         out["warmup"] = warm
+
+        from paddle_tpu import tuning as _tuning
+        from paddle_tpu.tuning.learned import store as _learned_store
+
+        def _rec(arm_name, block):
+            # serving passes measure one wall window, not iterated steps;
+            # the store row carries seconds-per-served-token so serving
+            # data reads on the same axis as the step timings
+            tps = block.get("served_tokens_per_sec") or 0
+            if tps > 0 and _learned_store.recording_enabled(tool=True):
+                _learned_store.record(
+                    "ab.serving",
+                    f"workload=serve rate={rate} reqs={args.requests}",
+                    "-", _tuning.device_kind(), arm_name,
+                    windows_s=[1.0 / tps], source="ab",
+                    extras={"wall_s": block.get("wall_s")})
+
+        _rec("tuned", out)
         if args.ab:
             base = run_open_loop(
                 _mk_engine(cfg, args, prefix_cache=False, draft_k=0), wl,
                 warmup=warm)
+            _rec("baseline", base)
             out["baseline"] = {
                 "served_tokens_per_sec": base["served_tokens_per_sec"],
                 "prefill_tokens_computed": base["prefill_tokens_computed"],

@@ -22,6 +22,12 @@ every end-of-round snapshot commit:
     python tools/gate.py --obs [F.json]    # telemetry block only (registry
                                            # overhead ceiling, metric-name
                                            # schema drift, missing block)
+    python tools/gate.py --costmodel       # learned cost model only: the
+                                           # committed model must beat the
+                                           # analytic prior on its holdout
+                                           # keys, and the newest bench's
+                                           # learned fallback rate must stay
+                                           # under the ceiling
 """
 from __future__ import annotations
 
@@ -50,8 +56,27 @@ RESNET_VS_TARGET_DROP = 0.95
 
 # a consult-mode bench whose workload resolved mostly off the swept DB is
 # running untuned — the DB is stale for these shapes (re-sweep with
-# tools/tune.py) or keyed for another device (ISSUE 6 acceptance line)
+# tools/tune.py) or keyed for another device (ISSUE 6 acceptance line).
+# Since the learned tier (ISSUE 15) a model prediction counts as tuned too:
+# the floor applies to tuned_rate ((db + learned) / decisions) when the
+# artifact carries it, hit_rate on older snapshots.
 TUNER_HIT_RATE_FLOOR = 0.5
+
+# learned cost model (ISSUE 15): the committed artifact must keep ranking
+# arms on its recorded holdout keys well enough to be worth a policy tier —
+# below this floor (or below the analytic prior it is supposed to beat),
+# the model is stale for the committed dataset; retrain with
+# tools/costmodel.py train. The floor sits under the committed model's
+# measured 1.0 so box-to-box eval noise does not flap the gate.
+COSTMODEL_RANK_ACC_FLOOR = 0.75
+COSTMODEL_DATA = "COSTMODEL_DATA_cpu.jsonl"
+COSTMODEL_MODEL = "COSTMODEL_cpu.json"
+
+# a consult/explore bench whose learned tier mostly fell through its
+# confidence gate is carrying a model that no longer covers the workload's
+# shapes (feature envelope drift, accuracy collapse) — above this fallback
+# rate the tier is dead weight; retrain on a fresher measurement store.
+LEARNED_FALLBACK_CEIL = 0.9
 
 # serving runtime (ISSUE 7): flag an artifact whose open-loop served
 # tokens/s falls more than this factor below the previous round's — the
@@ -290,24 +315,45 @@ def _check_tuner_coverage(data: dict, label: str) -> int:
     block (pre-tuner) and off-mode runs are skipped; a workload that made
     zero tunable decisions has nothing to tune and passes."""
     tun = data.get("tuning")
-    if not isinstance(tun, dict) or tun.get("mode") != "consult":
+    if not isinstance(tun, dict) or tun.get("mode") not in ("consult",
+                                                            "explore"):
         return 0
     rc = 0
     for wl, stats in sorted((tun.get("workloads") or {}).items()):
         n = stats.get("decisions") or 0
-        rate = stats.get("hit_rate")
+        # tuned_rate ((db + learned) / decisions) supersedes hit_rate once
+        # the learned tier exists: a confident model prediction is a tuned
+        # decision, not a fall-through. Old artifacts only carry hit_rate.
+        rate = stats.get("tuned_rate")
+        if rate is None:
+            rate = stats.get("hit_rate")
         if n == 0 or rate is None:
             continue
-        print(f"[gate] bench {label}: tuner {wl} hit-rate {rate} "
-              f"({stats.get('db_hits', 0)}/{n} decisions from the DB)",
+        print(f"[gate] bench {label}: tuner {wl} tuned-rate {rate} "
+              f"({stats.get('db_hits', 0)} db + "
+              f"{stats.get('learned', 0)} learned of {n} decisions)",
               flush=True)
         if rate < TUNER_HIT_RATE_FLOOR:
             print(f"[gate] FAIL: workload '{wl}' ran mostly untuned under "
-                  f"FLAGS_tuning_mode=consult (hit-rate {rate} < "
-                  f"{TUNER_HIT_RATE_FLOOR}) — the DB "
+                  f"FLAGS_tuning_mode={tun.get('mode')} (tuned-rate {rate} "
+                  f"< {TUNER_HIT_RATE_FLOOR}) — the DB "
                   f"({tun.get('db') or 'unset'}) is stale/mis-keyed for "
                   f"these shapes; re-sweep with tools/tune.py or run with "
                   f"tuning off", flush=True)
+            rc = 1
+    lr = tun.get("learned")
+    if isinstance(lr, dict) and (lr.get("attempts") or 0) > 0:
+        frate = lr.get("fallback_rate")
+        print(f"[gate] bench {label}: learned tier fallback-rate {frate} "
+              f"({lr.get('fallbacks', 0)}/{lr.get('attempts', 0)} attempts; "
+              f"reasons {lr.get('fallback_reasons') or {}})", flush=True)
+        if frate is not None and frate > LEARNED_FALLBACK_CEIL:
+            print(f"[gate] FAIL: the learned tier fell through its "
+                  f"confidence gate on {frate:.0%} of attempts "
+                  f"(> {LEARNED_FALLBACK_CEIL:.0%}) — the model "
+                  f"({tun.get('model') or 'unset'}) no longer covers this "
+                  f"workload's shapes; retrain with tools/costmodel.py "
+                  f"train on a fresher measurement store", flush=True)
             rc = 1
     return rc
 
@@ -721,6 +767,83 @@ def check_bench(path: str | None = None) -> int:
     return 0
 
 
+def check_costmodel(data_path: str | None = None,
+                    model_path: str | None = None) -> int:
+    """Learned cost-model gate (ISSUE 15): the committed model artifact must
+    keep beating the analytic prior on its recorded holdout keys.
+
+    Re-scores COSTMODEL_cpu.json against COSTMODEL_DATA_cpu.jsonl with the
+    same scorer tools/costmodel.py eval uses. Fails when any group's holdout
+    arm-ranking accuracy drops below COSTMODEL_RANK_ACC_FLOOR or below the
+    analytic prior's on the same keys (a learned tier that ranks worse than
+    the formula it shadows is a regression, not a tier). Also re-checks the
+    newest bench artifact's learned fallback rate (the consult-mode half of
+    the acceptance line) so `--costmodel` alone covers both. Repos without
+    the committed artifacts skip with a WARN — the gate stays meaningful on
+    old snapshots."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from paddle_tpu.tuning import learned
+
+    data_path = data_path or os.path.join(REPO, COSTMODEL_DATA)
+    model_path = model_path or os.path.join(REPO, COSTMODEL_MODEL)
+    if not os.path.exists(data_path) or not os.path.exists(model_path):
+        print(f"[gate] WARN: costmodel artifacts missing "
+              f"({COSTMODEL_DATA} / {COSTMODEL_MODEL}) — skipping",
+              flush=True)
+        return 0
+    try:
+        model = learned.load_model(model_path)
+    except ValueError as e:
+        print(f"[gate] FAIL: committed cost model {model_path} is "
+              f"unreadable ({e}) — retrain with tools/costmodel.py train",
+              flush=True)
+        return 1
+    if model is None:
+        print(f"[gate] WARN: cost model {model_path} vanished — skipping",
+              flush=True)
+        return 0
+    recs = list(learned.iter_records(data_path))
+    ev = learned.eval_model(model, recs)
+    rc = 0
+    if not ev["groups"]:
+        print(f"[gate] FAIL: committed cost model has no evaluable group "
+              f"against {os.path.basename(data_path)} — dataset/model "
+              f"drifted apart; re-run tools/costmodel.py train", flush=True)
+        return 1
+    for g, r in sorted(ev["groups"].items()):
+        acc, ana = r.get("rank_acc"), r.get("analytic_rank_acc")
+        print(f"[gate] costmodel {g}: holdout rank-acc {acc} vs analytic "
+              f"{ana} over {r.get('n')} keys", flush=True)
+        if acc is None:
+            continue
+        if acc < COSTMODEL_RANK_ACC_FLOOR:
+            print(f"[gate] FAIL: learned model ranks arms correctly on only "
+                  f"{acc:.0%} of {g} holdout keys "
+                  f"(floor {COSTMODEL_RANK_ACC_FLOOR:.0%}) — the committed "
+                  f"model is stale for the committed dataset; retrain with "
+                  f"tools/costmodel.py train", flush=True)
+            rc = 1
+        elif ana is not None and acc < ana:
+            print(f"[gate] FAIL: learned model ({acc:.0%}) ranks {g} "
+                  f"holdout arms WORSE than the analytic prior ({ana:.0%}) "
+                  f"it is supposed to beat — the tier is a regression; "
+                  f"retrain or widen the dataset", flush=True)
+            rc = 1
+    # the runtime half: the newest bench artifact's learned fallback rate
+    # (also enforced on --bench via _check_tuner_coverage; harmless twice)
+    arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if arts:
+        try:
+            with open(arts[-1]) as f:
+                data = _bench_metrics(f.read())
+        except (OSError, ValueError, IndexError):
+            data = None
+        if isinstance(data, dict):
+            rc = _check_tuner_coverage(data, os.path.basename(arts[-1])) or rc
+    return rc
+
+
 def main() -> int:
     if "--obs" in sys.argv:
         arg = sys.argv[sys.argv.index("--obs") + 1:]
@@ -735,12 +858,15 @@ def main() -> int:
         return run_chaos()
     if "--kernels" in sys.argv:
         return check_kernel_registry()
+    if "--costmodel" in sys.argv:
+        return check_costmodel()
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
         rc = rc or check_kernel_registry()
         rc = rc or check_bench()
         rc = rc or check_multichip()
+        rc = rc or check_costmodel()
     if rc == 0:
         print("[gate] OK — green suite, safe to snapshot")
     return rc
