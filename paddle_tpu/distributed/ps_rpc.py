@@ -325,16 +325,15 @@ class PSClient:
             lock = self._locks.setdefault(ep, threading.Lock())
         with lock:
             if ep not in self._conns:
-                deadline = time.monotonic() + rpc_deadline_s()
-                while True:
-                    try:
-                        self._conns[ep] = Client(_parse_ep(ep),
-                                                 authkey=_authkey())
-                        break
-                    except (ConnectionRefusedError, FileNotFoundError):
-                        if time.monotonic() > deadline:
-                            raise
-                        time.sleep(0.2)  # server may still be starting
+                from ..resilience.retry import connect_policy
+
+                def _dial():
+                    self._conns[ep] = Client(_parse_ep(ep),
+                                             authkey=_authkey())
+
+                # flat-interval, FLAGS_rpc_deadline-bounded dial (the
+                # server may still be starting) through the shared policy
+                connect_policy().call(_dial)
         return self._conns[ep], lock
 
     def _call(self, ep: str, meta: dict, tensors=(), timeout=None):

@@ -347,6 +347,38 @@ _define("serving_audit_every", 16,
         "holder counts) every N scheduler steps; a dirty audit triggers "
         "the recovery pass. 1 audits every step (chaos drills); <=0 "
         "disables the periodic audit")
+# serving fleet knobs (serving/fleet/: router + N engine replicas with
+# failure-domain isolation — see README "Serving fleet")
+_define("fleet_replicas", 1,
+        "default replica count for FleetRouter(): N independent engine "
+        "replicas (each its own KV pool, prefix cache, compile caches — "
+        "one failure domain each) behind the health-checked router. "
+        "Constructor argument overrides; 1 degenerates to a supervised "
+        "single engine")
+_define("fleet_heartbeat_s", 2.0,
+        "per-replica heartbeat deadline in seconds: a replica whose last "
+        "beat (stamped after every pump iteration, skipped by the "
+        "fleet_heartbeat_slow/hang/kill fault sites) is older than this is "
+        "declared DEAD and its in-flight requests fail over to survivors. "
+        "Scaled by FLAGS_watchdog_scale so loaded CI boxes widen the "
+        "margin without editing chaos plans; <=0 disables health checking "
+        "(replicas only die by explicit retire)")
+_define("fleet_failover_budget", 3,
+        "max failover re-placements per request over its lifetime (the "
+        "fleet RetryPolicy's max_attempts): each replica death costs the "
+        "request one attempt; past the budget the request lands in the "
+        "'failed' terminal state instead of hopping forever between dying "
+        "replicas")
+_define("fleet_affinity", True,
+        "prefix-cache-affinity placement: requests hash their prompt head "
+        "(FLAGS_fleet_affinity_tokens tokens) to a preferred replica so "
+        "same-system-prompt traffic lands on the replica already holding "
+        "those pages; an unhealthy/rejecting target degrades to "
+        "least-loaded. False = pure least-loaded placement")
+_define("fleet_affinity_tokens", 16,
+        "prompt-head length (tokens) hashed for affinity placement; "
+        "prompts shorter than this hash whole. Align to the page size so "
+        "requests sharing cached pages share a routing key")
 # tiered giant-embedding knobs (paddle_tpu/embedding/, the minimize()-time
 # rewrite in passes.rewrite_tiered_embeddings — see README "Tiered
 # embeddings")
@@ -407,6 +439,13 @@ _define("watchdog_stall_s", 600.0,
         "drains and DeviceLoader batch waits: if no progress within this "
         "many seconds a StallError carrying the in-flight state dump is "
         "raised instead of blocking forever; <=0 disables the watchdog")
+_define("watchdog_scale", 1.0,
+        "global multiplier on every watchdog/heartbeat deadline "
+        "(FLAGS_watchdog_stall_s windows and the fleet's "
+        "FLAGS_fleet_heartbeat_s): set >1 on loaded/slow CI runners so "
+        "chaos tests don't flake on scheduling noise without rewriting "
+        "per-site deadlines; values <1 are clamped to 1 (the margin only "
+        "ever widens)")
 # resilience runtime knobs (resilience/: faults, retry, checkpoint, runner)
 _define("fault_plan", "",
         "deterministic fault-injection plan for the named runtime sites "

@@ -111,6 +111,58 @@ DECLARED: list[tuple] = [
      "recovery-pass record (reason, quarantined, replayed, problems)", ()),
     ("serving.step_retry", EVENT,
      "one absorbed dispatch retry (kind, attempt, error)", ()),
+    # -- serving fleet (serving/fleet/: router + replicas, ISSUE 16) --------
+    ("fleet.submits", COUNTER, "requests accepted by the fleet router", ()),
+    ("fleet.finished", COUNTER, "fleet requests finished", ()),
+    ("fleet.failed", COUNTER,
+     "fleet requests failed: failover budget exhausted or no healthy "
+     "replica left to place on", ()),
+    ("fleet.sheds", COUNTER,
+     "submits refused fleet-wide (EVERY healthy replica shedding)", ()),
+    ("fleet.rejects", COUNTER,
+     "per-replica admission rejections absorbed by re-placement", ()),
+    ("fleet.failovers", COUNTER,
+     "budget-consuming re-placements (replica death or rejection)", ()),
+    ("fleet.handoffs", COUNTER,
+     "budget-free drain handoffs of waiting work off a DRAINING replica",
+     ()),
+    ("fleet.deaths", COUNTER,
+     "replicas declared DEAD (missed heartbeats or administrative kill)",
+     ()),
+    ("fleet.retires", COUNTER,
+     "replicas that completed drain-and-retire", ()),
+    ("fleet.replayed_tokens", COUNTER,
+     "already-delivered tokens a re-placement must regenerate", ()),
+    ("fleet.dedup_tokens", COUNTER,
+     "regenerated tokens suppressed by the router's delivered ledger "
+     "(each client token delivered exactly once)", ()),
+    ("fleet.replay_divergence", COUNTER,
+     "replayed positions that disagreed with the ledger (possible under "
+     "temperature sampling; must be 0 under greedy)", ()),
+    ("fleet.affinity_hits", COUNTER,
+     "placements landing on the prompt's affinity home replica", ()),
+    ("fleet.affinity_misses", COUNTER,
+     "placements degraded to least-loaded (home not HEALTHY)", ()),
+    ("fleet.affinity_hit_rate", GAUGE,
+     "affinity_hits / (hits + misses) over the router's lifetime", ()),
+    ("fleet.replicas_healthy", GAUGE, "replicas currently HEALTHY", ()),
+    ("fleet.replicas_draining", GAUGE, "replicas currently DRAINING", ()),
+    ("fleet.replicas_dead", GAUGE, "replicas currently DEAD", ()),
+    ("fleet.replica_state", GAUGE,
+     "per-replica lifecycle state (0=healthy 1=draining 2=retired 3=dead)",
+     ("rid",)),
+    ("fleet.drain_s", HISTOGRAM,
+     "drain-and-retire duration: begin_drain -> RETIRED", ()),
+    ("fleet.ttft_s", HISTOGRAM,
+     "fleet-level time to first DELIVERED token (failover included)", ()),
+    ("fleet.request_s", HISTOGRAM,
+     "fleet-level request latency: submit -> finished", ()),
+    ("fleet.replica", EVENT,
+     "replica lifecycle record (healthy/draining/dead/retired/crashed)",
+     ()),
+    ("fleet.request", EVENT,
+     "fleet request lifecycle record (placed/finished/failed/rejected/"
+     "budget_exhausted/unplaceable)", ()),
     # -- training step telemetry (executor.py async window) -----------------
     ("train.steps", COUNTER, "async steps drained to completion", ()),
     ("train.step_latency_s", HISTOGRAM,

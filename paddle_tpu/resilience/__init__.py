@@ -38,10 +38,12 @@ from .faults import (  # noqa: F401
     fault_stats,
     install_plan,
 )
-from .retry import RetryPolicy, io_policy, rpc_policy  # noqa: F401
+from .retry import (  # noqa: F401
+    RetryPolicy, connect_policy, fleet_policy, io_policy, rpc_policy)
 from .checkpoint import CheckpointManager  # noqa: F401
 from .runner import CheckpointedRunner, StepFailure  # noqa: F401
-from .watchdog import StallError, Watchdog, stall_window_s  # noqa: F401
+from .watchdog import (  # noqa: F401
+    HeartbeatMonitor, StallError, Watchdog, stall_window_s, watchdog_scale)
 from .guardrails import (  # noqa: F401
     GUARD_HEALTH_NAME,
     GUARD_STATE_NAME,
@@ -54,9 +56,11 @@ from .guardrails import (  # noqa: F401
 __all__ = [
     "FAULT_SITES", "FaultPlan", "InjectedFault", "fault_point",
     "fault_scope", "fault_stats", "install_plan",
-    "RetryPolicy", "io_policy", "rpc_policy",
+    "RetryPolicy", "io_policy", "rpc_policy", "fleet_policy",
+    "connect_policy",
     "CheckpointManager", "CheckpointedRunner", "StepFailure",
-    "StallError", "Watchdog", "stall_window_s",
+    "StallError", "Watchdog", "HeartbeatMonitor", "stall_window_s",
+    "watchdog_scale",
     "GUARD_HEALTH_NAME", "GUARD_STATE_NAME", "GuardError", "GuardRewind",
     "StepGuard", "replay_blame",
 ]

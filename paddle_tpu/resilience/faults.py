@@ -63,6 +63,27 @@ host, reproducibly. This module plants named *sites* in the hot paths —
                       the oldest live request's deadline is forced into
                       the past, so the expiry machinery must surface it
                       as deadline_exceeded with every page returned
+    fleet_replica_kill
+                      EngineReplica.pump_once, once per pump iteration —
+                      the replica dies SIGKILL-style: its engine is never
+                      touched again, its heartbeat stops, and NOTHING is
+                      announced; the router's HeartbeatMonitor must
+                      discover the death by missed beats and replay every
+                      in-flight request from its prompt on a survivor
+                      (token-deduplicated at the router, bitwise-exact
+                      under greedy)
+    fleet_replica_hang
+                      EngineReplica.pump_once — the replica wedges: the
+                      pump keeps getting called but makes no progress and
+                      stamps no beats (a hung host, not a dead one); the
+                      health checker must treat it exactly like a kill
+    fleet_heartbeat_slow
+                      EngineReplica.pump_once, at the beat stamp — ONE
+                      beat is silently dropped (a slow/loaded host), so a
+                      correctly-margined deadline (FLAGS_fleet_heartbeat_s
+                      x FLAGS_watchdog_scale) must NOT declare the replica
+                      dead; a scheduled run of hits starves the monitor
+                      into a (correct) death verdict
     emb_host_stall    the tiered-embedding miss resolver
                       (embedding/engine.resolve_feed) — the host-tier
                       prefetch parks forever (a hung remote shard / page-in
@@ -102,7 +123,8 @@ FAULT_SITES = frozenset({
     "rpc_drop", "trainer_crash", "heartbeat_loss", "pipeline_stall",
     "collective_stall", "numeric_nan", "numeric_spike", "serving_abort",
     "emb_host_stall", "serving_step_fail", "serving_pool_corrupt",
-    "serving_deadline",
+    "serving_deadline", "fleet_replica_kill", "fleet_replica_hang",
+    "fleet_heartbeat_slow",
 })
 
 

@@ -20,7 +20,8 @@ import hashlib
 import time
 from typing import Callable, Iterable
 
-__all__ = ["RetryPolicy", "rpc_policy", "io_policy", "serving_policy"]
+__all__ = ["RetryPolicy", "rpc_policy", "io_policy", "serving_policy",
+           "fleet_policy", "connect_policy"]
 
 _TRANSIENT = (ConnectionError, EOFError, TimeoutError, OSError)
 
@@ -120,6 +121,40 @@ def serving_policy(**overrides) -> RetryPolicy:
     kw = dict(
         max_attempts=max(1, flags.get_flag("serving_step_retries")),
         base_delay=0.001, max_delay=0.02, deadline=None)
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def fleet_policy(**overrides) -> RetryPolicy:
+    """Policy for fleet-router failover placement: max_attempts IS the
+    per-request failover budget (FLAGS_fleet_failover_budget — one attempt
+    per replica death), and the millisecond backoff paces re-placement
+    when every survivor momentarily rejects. AdmissionRejected counts as
+    transient here — a shedding replica is a full replica, and another one
+    (or the same one a beat later) may admit."""
+    from .. import flags
+    from ..serving.engine import AdmissionRejected
+
+    kw = dict(
+        max_attempts=max(1, flags.get_flag("fleet_failover_budget")),
+        base_delay=0.002, max_delay=0.05, deadline=None,
+        retryable=_TRANSIENT + (AdmissionRejected,))
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def connect_policy(**overrides) -> RetryPolicy:
+    """Policy for first-connection dials (PSClient._conn): flat 0.2s
+    interval — the server may simply still be starting, so backoff growth
+    buys nothing — bounded by the FLAGS_rpc_deadline wall clock rather
+    than an attempt count. Replaces the inline sleep-loop copy of this
+    same math that used to live in ps_rpc."""
+    from ..distributed.ps_rpc import rpc_deadline_s
+
+    kw = dict(
+        max_attempts=10_000_000, base_delay=0.2, max_delay=0.2,
+        multiplier=1.0, jitter=0.0, deadline=rpc_deadline_s(),
+        retryable=(ConnectionRefusedError, FileNotFoundError))
     kw.update(overrides)
     return RetryPolicy(**kw)
 

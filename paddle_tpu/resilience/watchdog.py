@@ -26,17 +26,31 @@ import logging
 import time
 from typing import Callable
 
-__all__ = ["StallError", "Watchdog", "stall_window_s", "runtime_state"]
+__all__ = ["StallError", "Watchdog", "HeartbeatMonitor", "stall_window_s",
+           "watchdog_scale", "runtime_state"]
 
 logger = logging.getLogger("paddle_tpu.resilience.watchdog")
 
 
-def stall_window_s() -> float:
-    """The configured watchdog window in seconds (<=0 = disabled)."""
+def watchdog_scale() -> float:
+    """FLAGS_watchdog_scale, clamped to >= 1.0: one global multiplier every
+    watchdog window and heartbeat deadline applies, so a loaded CI runner
+    widens every margin at once instead of flaking site by site."""
     from .. import flags
 
     try:
-        return float(flags.get_flag("watchdog_stall_s"))
+        return max(1.0, float(flags.get_flag("watchdog_scale")))
+    except KeyError:  # flags module mid-import
+        return 1.0
+
+
+def stall_window_s() -> float:
+    """The configured watchdog window in seconds (<=0 = disabled), widened
+    by FLAGS_watchdog_scale."""
+    from .. import flags
+
+    try:
+        return float(flags.get_flag("watchdog_stall_s")) * watchdog_scale()
     except KeyError:  # flags module mid-import
         return 0.0
 
@@ -99,6 +113,50 @@ class Watchdog:
                                  state() if state is not None else {})
             time.sleep(interval)
             interval = min(interval * 2, 0.05)
+
+
+class HeartbeatMonitor:
+    """Per-participant heartbeat ledger: the Watchdog generalized from one
+    bounded wait to N long-lived peers (fleet engine replicas, and the
+    same shape the pserver's trainer-liveness monitor keeps server-side).
+
+    Participants `register()` once and `beat()` whenever they make
+    progress; `overdue(now)` returns everyone whose last beat is older
+    than the deadline — the caller owns what "dead" means (the fleet
+    router fails their work over, a trainer monitor evicts them from the
+    barrier). The deadline is widened by FLAGS_watchdog_scale exactly like
+    the stall windows, so one CI knob de-flakes every liveness check.
+    A deadline <= 0 disables the monitor (`overdue` is always empty)."""
+
+    def __init__(self, deadline_s: float, scale: float | None = None):
+        self.deadline_s = float(deadline_s) * (
+            watchdog_scale() if scale is None else max(1.0, float(scale)))
+        self._last: dict[str, float] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.deadline_s > 0.0
+
+    def register(self, name: str, now: float | None = None) -> None:
+        self._last[name] = time.monotonic() if now is None else now
+
+    def deregister(self, name: str) -> None:
+        self._last.pop(name, None)
+
+    def beat(self, name: str, now: float | None = None) -> None:
+        if name in self._last:
+            self._last[name] = time.monotonic() if now is None else now
+
+    def age(self, name: str, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        return now - self._last[name]
+
+    def overdue(self, now: float | None = None) -> list[str]:
+        if not self.enabled:
+            return []
+        now = time.monotonic() if now is None else now
+        return [n for n, t in self._last.items()
+                if now - t > self.deadline_s]
 
 
 def runtime_state(**extra) -> dict:

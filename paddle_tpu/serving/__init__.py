@@ -21,7 +21,11 @@ Four pieces, one runtime:
                    chaos-abort page reclamation with refcount accounting;
   * `sampling`   — per-request temperature/top-k/top-p with per-(seed,
                    request, token) determinism across batch-bucket
-                   recompiles.
+                   recompiles;
+  * `fleet`      — N engine replicas behind one router: heartbeat health
+                   checking, prefix-affinity placement, failover replay
+                   with exactly-once token delivery, drain-and-retire
+                   (FLAGS_fleet_*, README "Serving fleet").
 
 Knobs: FLAGS_serving_page_size, FLAGS_serving_pool_pages,
 FLAGS_serving_max_inflight, FLAGS_serving_sched_policy,
@@ -39,8 +43,11 @@ from .model import (DecoderConfig, build_decode_program,
                     build_full_forward_program, build_prefill_program,
                     build_window_program, decoder_tiny)
 from .sampling import SamplingParams, sample_token
+from .fleet import (EngineReplica, FleetRequest, FleetRouter,
+                    NoHealthyReplica)
 
 __all__ = [
+    "EngineReplica", "FleetRouter", "FleetRequest", "NoHealthyReplica",
     "ServingEngine", "GenRequest", "ContinuousBatchingScheduler",
     "AdmissionRejected",
     "PagedKVPool", "PrefixCache", "pool_var_names", "create_device_pools",

@@ -1,0 +1,21 @@
+"""Replica fleet: failure-domain isolation for the serving engine.
+
+One `FleetRouter` in front of N in-process `EngineReplica`s — each a full
+`ServingEngine` with its own KV pool, prefix cache, and compile caches,
+so a replica death takes nothing down but itself. The router owns
+placement (prefix-cache affinity, least-loaded fallback), heartbeat
+health checking, failover replay with exactly-once token delivery, and
+drain-and-retire live migration. See router.py for the full contract,
+README "Serving fleet" for the operator view, and FLAGS_fleet_* for the
+knobs.
+"""
+from .replica import (  # noqa: F401
+    DEAD, DRAINING, HEALTHY, RETIRED, STATE_ORDINAL, EngineReplica)
+from .router import (  # noqa: F401
+    FLEET_TERMINAL, FleetRequest, FleetRouter, NoHealthyReplica)
+
+__all__ = [
+    "EngineReplica", "FleetRouter", "FleetRequest", "NoHealthyReplica",
+    "HEALTHY", "DRAINING", "DEAD", "RETIRED", "STATE_ORDINAL",
+    "FLEET_TERMINAL",
+]

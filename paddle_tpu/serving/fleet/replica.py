@@ -1,0 +1,272 @@
+"""One engine replica as a failure domain.
+
+`EngineReplica` owns one `ServingEngine` (its own KV pool, prefix cache,
+compile caches) plus the thin shell the router needs around it: a
+thread-safe inbox of placement jobs, an outbox of streamed results, a
+heartbeat, and a lifecycle. EVERYTHING that touches the engine happens
+inside `pump_once()` — the engine stays single-threaded by construction,
+whether the pump runs inline on the router's thread or on the replica's
+own worker thread (`FleetRouter(pump="threads")`).
+
+Lifecycle (the three-state contract of ISSUE 16, plus the clean exit):
+
+    HEALTHY  --drain()-->  DRAINING  --(no work left)-->  RETIRED
+       |                      |
+       +----- kill / hang / crash: beats stop ----->      DEAD
+                     (discovered by the router's HeartbeatMonitor)
+
+A DRAINING replica admits nothing: jobs still in its inbox bounce back
+("handoff") and engine requests still WAITING (admitted to the engine's
+queue but not yet prefilled — including requests the engine preempted
+mid-drain) are aborted engine-side and handed off; RUNNING decodes finish
+in place. When the engine drains empty the replica RETIRES and stamps its
+drain duration — elastic scale-down with zero shed requests.
+
+Death is never announced. The `fleet_replica_kill` site stops the pump
+cold (SIGKILL: the engine is never touched again), `fleet_replica_hang`
+wedges it (pumps keep arriving, nothing progresses), an engine exception
+freezes it (the OOM-kill stand-in) — in every case the only symptom is a
+heartbeat that stops, exactly like a preempted TPU host, and the router
+must notice via missed beats and replay the replica's in-flight work.
+
+Outbox event shapes (consumed by FleetRouter.poll):
+    ("tokens",  fid, start_index, [tok, ...])   streamed generation delta
+    ("done",    fid, terminal_engine_state)     request left the engine
+    ("reject",  fid, retry_after_s)             engine admission refused
+    ("handoff", fid)                            draining replica gave it up
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ... import observability as obs
+from ...resilience.faults import InjectedFault, fault_point
+
+__all__ = ["EngineReplica", "HEALTHY", "DRAINING", "DEAD", "RETIRED",
+           "STATE_ORDINAL"]
+
+HEALTHY, DRAINING, DEAD, RETIRED = "healthy", "draining", "dead", "retired"
+# gauge encoding for the per-replica fleet.replica_state series
+STATE_ORDINAL = {HEALTHY: 0, DRAINING: 1, RETIRED: 2, DEAD: 3}
+
+# engine terminal states (mirrors serving.engine._TERMINAL without reaching
+# into the engine module's privates)
+_ENGINE_TERMINAL = frozenset(
+    {"finished", "aborted", "deadline_exceeded", "shed"})
+
+
+class EngineReplica:
+    """One engine + inbox/outbox/heartbeat shell. See the module docstring
+    for the lifecycle; the router is the only writer of `state` except for
+    the DRAINING->RETIRED transition, which the pump takes itself (only it
+    knows when the engine is empty)."""
+
+    def __init__(self, rid: int, engine, monitor, name: str | None = None):
+        self.rid = int(rid)
+        self.engine = engine
+        self.monitor = monitor
+        self.name = name or f"replica{rid}"
+        self.state = HEALTHY
+        self._inbox: deque = deque()
+        self._outbox: deque = deque()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._hung = False
+        self.crash: BaseException | None = None
+        self.t_drain_start: float | None = None
+        # stamped at every pump ENTRY: the router's health check compares it
+        # to the last beat, so "pumped since the beat yet never beat again"
+        # (kill/hang/crash) reads as death while "beat stale because the
+        # shared inline thread sat in a neighbor's XLA compile" does not
+        self.t_last_pump = time.monotonic()
+        # pump-side maps: engine rid -> (fid, tokens already streamed out)
+        self._fid_of: dict[int, int] = {}
+        self._sent: dict[int, int] = {}
+        monitor.register(self.name)
+
+    # -- router-side API (thread-safe) --------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self.state in (HEALTHY, DRAINING)
+
+    def enqueue(self, job: dict) -> None:
+        """Queue one placement job ({fid, prompt, max_new_tokens, eos_id,
+        sampling, priority, deadline_s}) or control ({abort: fid})."""
+        with self._lock:
+            self._inbox.append(job)
+
+    def drain_events(self) -> list[tuple]:
+        with self._lock:
+            out = list(self._outbox)
+            self._outbox.clear()
+        return out
+
+    def load(self) -> int:
+        """Jobs this replica holds that the router still waits on — the
+        router-visible placement load (inbox + streamed-but-unfinished)."""
+        with self._lock:
+            return len(self._inbox) + len(self._fid_of)
+
+    def begin_drain(self) -> None:
+        if self.state == HEALTHY:
+            self.state = DRAINING
+            self.t_drain_start = time.perf_counter()
+
+    def mark_dead(self) -> None:
+        self.state = DEAD
+        self._stop.set()
+        self.monitor.deregister(self.name)
+
+    def sigkill(self) -> None:
+        """SIGKILL-equivalent silent death (the chaos/bench trigger): the
+        pump stops cold, the engine is never touched again, NOTHING is
+        announced — the router must discover it by missed heartbeats. The
+        `fleet_replica_kill` fault site lands here too."""
+        self._hung = True
+        if self.crash is None:
+            self.crash = RuntimeError("sigkill")
+
+    # -- worker thread (FleetRouter pump="threads") -------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._worker, name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set() and self.alive:
+            if not self.pump_once():
+                # idle (or wedged): yield without burning the core
+                time.sleep(0.001)
+
+    # -- the pump (the ONLY code that touches the engine) -------------------
+    def pump_once(self) -> bool:
+        """One replica iteration: fault sites -> admit inbox -> one engine
+        step -> stream deltas -> retire check -> heartbeat. Returns True if
+        anything progressed."""
+        if not self.alive:
+            return False
+        self.t_last_pump = time.monotonic()
+        try:
+            fault_point("fleet_replica_kill")
+        except InjectedFault as e:
+            # SIGKILL: no cleanup, no announcement — the heartbeat just
+            # stops and the router must discover the death by missed beats
+            self.crash = e
+            self.sigkill()
+            return False
+        try:
+            fault_point("fleet_replica_hang")
+        except InjectedFault:
+            self._hung = True  # wedged host: pumps arrive, nothing moves
+        if self._hung:
+            return False
+        try:
+            progressed = self._pump_inner()
+        except Exception as e:  # noqa: BLE001 — a crashed engine IS a death
+            self.crash = e
+            self._hung = True
+            obs.event("fleet.replica",
+                      {"rid": self.rid, "state": "crashed",
+                       "error": repr(e)}, level="error")
+            return False
+        # the beat says "this replica made a scheduling decision", even an
+        # idle one; the slow-heartbeat site drops ONE stamp (a loaded host)
+        try:
+            fault_point("fleet_heartbeat_slow")
+            self.monitor.beat(self.name)
+        except InjectedFault:
+            pass
+        return progressed
+
+    def _pump_inner(self) -> bool:
+        progressed = self._admit_inbox()
+        if self.state == DRAINING:
+            self._handoff_waiting()
+        if self.engine.has_work():
+            self.engine.step()
+            progressed = True
+        self._stream_deltas()
+        if (self.state == DRAINING and not self.engine.has_work()
+                and not self._inbox):
+            self.state = RETIRED
+            self._stop.set()
+            self.monitor.deregister(self.name)
+        return progressed
+
+    def _admit_inbox(self) -> bool:
+        with self._lock:
+            jobs, self._inbox = list(self._inbox), deque()
+        moved = False
+        for job in jobs:
+            if "abort" in job:
+                fid = job["abort"]
+                erids = [e for e, f in self._fid_of.items() if f == fid]
+                for erid in erids:
+                    self.engine.abort(erid)
+                moved = True
+                continue
+            fid = job["fid"]
+            if self.state == DRAINING:
+                self._emit("handoff", fid)
+                continue
+            try:
+                erid = self.engine.submit(
+                    job["prompt"], job["max_new_tokens"],
+                    eos_id=job.get("eos_id"),
+                    sampling=job.get("sampling"),
+                    deadline_s=job.get("deadline_s"),
+                    priority=job.get("priority"))
+            except Exception as e:  # AdmissionRejected (or a bad request)
+                self._emit("reject", fid,
+                           getattr(e, "retry_after_s", 0.05))
+                continue
+            self._fid_of[erid] = fid
+            self._sent[erid] = 0
+            moved = True
+        return moved
+
+    def _handoff_waiting(self) -> None:
+        """A draining replica's engine-side WAITING requests (never
+        prefilled, or preempted back mid-drain) abort locally and bounce to
+        the router for re-placement; RUNNING decodes finish in place."""
+        for erid, fid in list(self._fid_of.items()):
+            req = self.engine.requests.get(erid)
+            if req is not None and req.state == "waiting":
+                self.engine.abort(erid)
+                # pop ONLY this record — a blanket prune_finished() here
+                # would swallow same-step terminals not yet streamed out
+                self.engine.pop_result(erid)
+                self._fid_of.pop(erid, None)
+                self._sent.pop(erid, None)
+                self._emit("handoff", fid)
+
+    def _stream_deltas(self) -> None:
+        for erid, fid in list(self._fid_of.items()):
+            req = self.engine.requests.get(erid)
+            if req is None:  # record vanished underneath us: surface it as
+                self._emit("done", fid, "aborted")  # lost, never go silent
+                self._fid_of.pop(erid, None)
+                self._sent.pop(erid, None)
+                continue
+            sent = self._sent[erid]
+            out = req.out_tokens
+            if len(out) > sent:
+                self._emit("tokens", fid, sent, out[sent:])
+                self._sent[erid] = len(out)
+            if req.state in _ENGINE_TERMINAL:
+                self._emit("done", fid, req.state)
+                self.engine.pop_result(erid)
+                self._fid_of.pop(erid, None)
+                self._sent.pop(erid, None)
+
+    def _emit(self, *event) -> None:
+        with self._lock:
+            self._outbox.append(tuple(event))

@@ -1,0 +1,469 @@
+"""Fleet router: N engine replicas behind one submit/poll surface.
+
+`FleetRouter` fronts N in-process `EngineReplica`s (each a full
+`ServingEngine` — own KV pool, prefix cache, compile caches — i.e. one
+failure domain) and owns everything that must survive a replica death:
+
+  * placement — prefix-cache affinity first (`FLAGS_fleet_affinity`: the
+    prompt head hashes to a home replica, so shared-prefix traffic keeps
+    hitting the replica that already caches it), degrading gracefully to
+    least-loaded whenever the home replica is not HEALTHY;
+  * health — a `HeartbeatMonitor` over per-replica beats stamped by the
+    pumps; a beat older than FLAGS_fleet_heartbeat_s (widened by
+    FLAGS_watchdog_scale for slow CI) declares the replica DEAD. Death is
+    *discovered*, never announced — kills, hangs, and engine crashes all
+    look identical from here: a heartbeat that stopped;
+  * failover — every request in flight on a dead replica is replayed from
+    its prompt on a survivor through `resilience.retry.fleet_policy` (the
+    shared RetryPolicy; max_attempts IS the per-request budget). The
+    router keeps the authoritative per-request token ledger (`delivered`),
+    so the replay's regenerated prefix is deduplicated position-by-
+    position: clients see each token exactly once, and under greedy
+    decoding the replayed suffix is bitwise-identical to what the dead
+    replica would have produced (batch-composition invariance — the same
+    property PR 13's in-engine recovery replay leans on). Positions that
+    DO disagree (possible under temperature sampling, where the replay
+    re-draws) are suppressed and counted as fleet.replay_divergence;
+  * drain-and-retire — `drain(rid)` moves a replica to DRAINING: it
+    admits nothing, hands off engine-WAITING work immediately (replayed
+    elsewhere, budget-free — a planned migration is not a failure), lets
+    RUNNING decodes finish, then RETIRES and stamps fleet.drain_s. Zero
+    requests shed: live scale-down;
+  * fleet-wide shed — admission is refused (`AdmissionRejected`, same
+    type as the engine's) only when EVERY healthy replica reports PR 13
+    overload signals; a single overloaded replica just loses the
+    placement. Per-replica rejections bounce back asynchronously and
+    re-place on another replica under the same failover budget.
+
+Pump modes: `pump="inline"` (default) steps every replica on the caller's
+thread inside `step()` — fully deterministic, what the failover-exactness
+tests and chaos drills use; `pump="threads"` gives each replica a worker
+thread (the serving topology, and what the fleet bench's scaling arms
+measure) — the router thread then only routes and polls.
+
+Replay exactness requires every replica to serve the SAME model: the
+`engine_factory` must build identically-seeded engines.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Callable
+
+from ... import observability as obs
+from ...resilience.retry import fleet_policy
+from ...resilience.watchdog import HeartbeatMonitor
+from ..engine import AdmissionRejected
+from .replica import (DEAD, DRAINING, HEALTHY, RETIRED, STATE_ORDINAL,
+                      EngineReplica)
+
+__all__ = ["FleetRouter", "FleetRequest", "NoHealthyReplica",
+           "QUEUED", "FINISHED", "FAILED", "FLEET_TERMINAL"]
+
+QUEUED, FINISHED, FAILED = "queued", "finished", "failed"
+# aborted / deadline_exceeded / shed arrive verbatim from the engine
+FLEET_TERMINAL = frozenset(
+    {FINISHED, FAILED, "aborted", "deadline_exceeded", "shed"})
+
+
+class NoHealthyReplica(ConnectionError):
+    """Placement found no HEALTHY replica to target (ConnectionError so the
+    fleet RetryPolicy treats it as transient while any budget remains)."""
+
+
+class FleetRequest:
+    """Router-side record of one request: where it lives now and the
+    authoritative `delivered` token ledger that makes failover replay
+    exactly-once from the client's point of view."""
+
+    __slots__ = ("fid", "prompt", "max_new_tokens", "eos_id", "sampling",
+                 "priority", "deadline_s", "state", "replica", "delivered",
+                 "failovers", "aborting", "t_submit", "t_first", "t_done")
+
+    def __init__(self, fid: int, prompt, max_new_tokens: int, eos_id,
+                 sampling, priority, deadline_s):
+        self.fid = fid
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.sampling = sampling
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.state = QUEUED
+        self.replica: int | None = None
+        self.delivered: list[int] = []
+        self.failovers = 0
+        self.aborting = False
+        self.t_submit = time.perf_counter()
+        self.t_first: float | None = None
+        self.t_done: float | None = None
+
+    def job(self) -> dict:
+        return {"fid": self.fid, "prompt": self.prompt,
+                "max_new_tokens": self.max_new_tokens, "eos_id": self.eos_id,
+                "sampling": self.sampling, "priority": self.priority,
+                "deadline_s": self.deadline_s}
+
+
+class FleetRouter:
+    def __init__(self, engine_factory: Callable[[], object],
+                 n_replicas: int | None = None, *,
+                 heartbeat_s: float | None = None,
+                 affinity: bool | None = None,
+                 affinity_tokens: int | None = None,
+                 failover_budget: int | None = None,
+                 pump: str = "inline"):
+        """engine_factory() -> ServingEngine, called once per replica; it
+        MUST seed every engine identically (same weights) or failover
+        replay loses bitwise exactness. Knobs default from FLAGS_fleet_*."""
+        from ... import flags
+
+        if pump not in ("inline", "threads"):
+            raise ValueError(f"pump must be 'inline' or 'threads', got {pump!r}")
+        n = int(flags.get_flag("fleet_replicas")
+                if n_replicas is None else n_replicas)
+        if n < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.heartbeat_s = float(flags.get_flag("fleet_heartbeat_s")
+                                 if heartbeat_s is None else heartbeat_s)
+        self.affinity = bool(flags.get_flag("fleet_affinity")
+                             if affinity is None else affinity)
+        self.affinity_tokens = int(flags.get_flag("fleet_affinity_tokens")
+                                   if affinity_tokens is None
+                                   else affinity_tokens)
+        self.pump = pump
+        self._factory = engine_factory
+        # deadline already scaled by watchdog_scale inside HeartbeatMonitor
+        self.monitor = HeartbeatMonitor(self.heartbeat_s)
+        self._retry = fleet_policy() if failover_budget is None \
+            else fleet_policy(max_attempts=max(1, failover_budget))
+        self.replicas: list[EngineReplica] = []
+        self.requests: dict[int, FleetRequest] = {}
+        self._next_fid = 0
+        self._retire_seen: set[int] = set()
+        self.stats: dict[str, int] = {
+            "submits": 0, "finished": 0, "failed": 0, "sheds": 0,
+            "rejects": 0, "failovers": 0, "handoffs": 0, "deaths": 0,
+            "retires": 0, "replayed_tokens": 0, "dedup_tokens": 0,
+            "replay_divergence": 0, "affinity_hits": 0, "affinity_misses": 0,
+        }
+        self._started = False
+        for _ in range(n):
+            self.add_replica()
+        if pump == "threads":
+            self._started = True
+            for rep in self.replicas:
+                rep.start()
+
+    # -- fleet membership ---------------------------------------------------
+    def add_replica(self) -> EngineReplica:
+        """Scale up by one failure domain (elastic counterpart of drain)."""
+        rep = EngineReplica(len(self.replicas), self._factory(), self.monitor)
+        self.replicas.append(rep)
+        obs.event("fleet.replica", {"rid": rep.rid, "state": HEALTHY})
+        if self.pump == "threads" and self._started:
+            rep.start()
+        self._refresh_gauges()
+        return rep
+
+    def drain(self, rid: int) -> None:
+        """Begin drain-and-retire on one replica: admits nothing from now
+        on, hands off its waiting work, finishes its running decodes,
+        retires. Completion shows up as fleet.retires / fleet.drain_s."""
+        rep = self.replicas[rid]
+        rep.begin_drain()
+        obs.event("fleet.replica", {"rid": rid, "state": DRAINING})
+        self._refresh_gauges()
+
+    def kill(self, rid: int) -> None:
+        """Administrative kill (tests/chaos): same path a discovered death
+        takes — mark dead and fail over its in-flight requests."""
+        self._on_dead(self.replicas[rid], reason="killed")
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, *, eos_id=None,
+               sampling=None, priority: int | None = None,
+               deadline_s: float | None = None) -> int:
+        """Place one request; returns the fleet request id. Raises
+        AdmissionRejected only on FLEET-WIDE overload (every healthy
+        replica tripping PR 13 shed signals); single-replica rejections
+        are absorbed by re-placement."""
+        sig = self.overload_signals()
+        if sig is not None:
+            self._count("sheds")
+            obs.event("fleet.request",
+                      {"fid": -1, "phase": "rejected", "signals": sig},
+                      level="warning")
+            reasons = sorted({k for s in sig.values() for k in s})
+            raise AdmissionRejected("fleet:" + ",".join(reasons), 0.05,
+                                    {str(r): s for r, s in sig.items()})
+        fid = self._next_fid
+        self._next_fid += 1
+        freq = FleetRequest(fid, prompt, max_new_tokens, eos_id, sampling,
+                            priority, deadline_s)
+        self.requests[fid] = freq
+        self._count("submits")
+        try:
+            self._place(freq, exclude=frozenset())
+        except NoHealthyReplica:
+            self._finish(freq, FAILED, "failed")
+            raise
+        return fid
+
+    def abort(self, fid: int) -> None:
+        freq = self.requests[fid]
+        if freq.state in FLEET_TERMINAL:
+            return
+        freq.aborting = True
+        if freq.replica is not None:
+            self.replicas[freq.replica].enqueue({"abort": fid})
+
+    def state(self, fid: int) -> str:
+        return self.requests[fid].state
+
+    def result(self, fid: int) -> list[int]:
+        """The delivered-token ledger — every token exactly once, in
+        order, regardless of how many replicas the request lived on."""
+        return list(self.requests[fid].delivered)
+
+    def overload_signals(self) -> dict | None:
+        """Fleet-wide aggregate of per-replica PR 13 overload signals.
+        None = at least one healthy replica can absorb work; a dict (rid ->
+        signals) = EVERY healthy replica is shedding, the fleet-wide
+        refusal condition."""
+        per: dict = {}
+        healthy = [r for r in self.replicas if r.state == HEALTHY]
+        if not healthy:
+            return None  # placement failure, not overload — handled there
+        for rep in healthy:
+            try:
+                sig = rep.engine._overload_signals()
+            except Exception:  # racing a death: count it as not-shedding
+                return None
+            if not sig:
+                return None
+            per[rep.rid] = sig
+        return per
+
+    # -- progress ------------------------------------------------------------
+    def step(self) -> bool:
+        """One router iteration. Inline pump: pump every live replica then
+        poll; threaded pump: just poll (the workers pump themselves)."""
+        progressed = False
+        if self.pump == "inline":
+            for rep in self.replicas:
+                if rep.alive:
+                    progressed |= rep.pump_once()
+        return self.poll() or progressed
+
+    def poll(self) -> bool:
+        """Drain replica outboxes, run the health check, account retires."""
+        progressed = False
+        for rep in self.replicas:
+            for ev in rep.drain_events():
+                progressed = True
+                self._handle(rep, ev)
+            if rep.state == RETIRED and rep.rid not in self._retire_seen:
+                self._retire_seen.add(rep.rid)
+                self._count("retires")
+                dt = time.perf_counter() - (rep.t_drain_start or
+                                            time.perf_counter())
+                obs.histogram_observe("fleet.drain_s", dt)
+                obs.event("fleet.replica", {"rid": rep.rid, "state": RETIRED,
+                                            "drain_s": round(dt, 4)})
+                self._refresh_gauges()
+                progressed = True
+        self._check_health()
+        return progressed
+
+    def run_until_idle(self, max_steps: int = 200_000,
+                       idle_sleep_s: float = 0.0005) -> None:
+        """Drive step() until every request is terminal. Sleeps a hair on
+        no-progress iterations so wall clock advances past heartbeat
+        deadlines (that is how a silent death gets discovered)."""
+        for _ in range(max_steps):
+            if all(r.state in FLEET_TERMINAL for r in self.requests.values()):
+                return
+            if not self.step():
+                time.sleep(idle_sleep_s)
+        raise RuntimeError(
+            f"fleet did not go idle in {max_steps} steps; live="
+            f"{[f.fid for f in self.requests.values() if f.state not in FLEET_TERMINAL]}")
+
+    def shutdown(self) -> None:
+        for rep in self.replicas:
+            rep.stop(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # -- placement -----------------------------------------------------------
+    def _healthy(self, exclude=frozenset()) -> list[EngineReplica]:
+        return [r for r in self.replicas
+                if r.state == HEALTHY and r.rid not in exclude]
+
+    def _affinity_rid(self, prompt) -> int:
+        head = tuple(prompt[:self.affinity_tokens])
+        h = hashlib.sha256(repr(head).encode()).digest()
+        # modulo the FIXED replica universe so a death or retire elsewhere
+        # never reshuffles every other prompt's home
+        return int.from_bytes(h[:8], "big") % len(self.replicas)
+
+    def _place(self, freq: FleetRequest, exclude=frozenset()) -> None:
+        cands = self._healthy(exclude)
+        if not cands:
+            raise NoHealthyReplica(
+                f"no healthy replica for fid={freq.fid} "
+                f"(excluded {sorted(exclude)})")
+        if self.affinity:
+            home = self._affinity_rid(freq.prompt)
+            rep = next((r for r in cands if r.rid == home), None)
+            if rep is not None:
+                self._count("affinity_hits")
+            else:  # graceful degradation: least-loaded healthy survivor
+                self._count("affinity_misses")
+                rep = min(cands, key=lambda r: (r.load(), r.rid))
+        else:
+            rep = min(cands, key=lambda r: (r.load(), r.rid))
+        hits, misses = self.stats["affinity_hits"], self.stats["affinity_misses"]
+        if hits + misses:
+            obs.gauge_set("fleet.affinity_hit_rate", hits / (hits + misses))
+        freq.replica = rep.rid
+        rep.enqueue(freq.job())
+        obs.event("fleet.request",
+                  {"fid": freq.fid, "phase": "placed", "rid": rep.rid,
+                   "failovers": freq.failovers})
+
+    def _replace(self, freq: FleetRequest, exclude, reason: str) -> None:
+        """Move a live request to another replica. `reason` decides the
+        cost: failover/reject consume the per-request budget (the
+        fleet_policy max_attempts), a drain handoff is free — planned
+        migration is not a failure."""
+        freq.replica = None
+        if reason == "handoff":
+            self._count("handoffs")
+        else:
+            if freq.failovers >= self._retry.max_attempts:
+                self._finish(freq, FAILED, "failed")
+                obs.event("fleet.request",
+                          {"fid": freq.fid, "phase": "budget_exhausted",
+                           "failovers": freq.failovers}, level="error")
+                return
+            freq.failovers += 1
+            self._count("failovers")
+            if reason == "reject":  # pace re-placement onto shedding peers
+                time.sleep(self._retry.delay(freq.failovers))
+        # the replay starts from the prompt; everything already delivered
+        # will be regenerated and suppressed by the ledger
+        self._count("replayed_tokens", len(freq.delivered))
+        try:
+            self._place(freq, exclude=exclude)
+        except NoHealthyReplica:
+            self._finish(freq, FAILED, "failed")
+            obs.event("fleet.request",
+                      {"fid": freq.fid, "phase": "unplaceable",
+                       "failovers": freq.failovers}, level="error")
+
+    # -- event handling ------------------------------------------------------
+    def _handle(self, rep: EngineReplica, ev: tuple) -> None:
+        kind, fid = ev[0], ev[1]
+        freq = self.requests.get(fid)
+        if freq is None or freq.replica != rep.rid \
+                or freq.state in FLEET_TERMINAL:
+            return  # stale: the request moved on (failover beat this event)
+        if kind == "tokens":
+            start, toks = ev[2], ev[3]
+            for i, tok in enumerate(toks, start):
+                if i < len(freq.delivered):
+                    # replayed ground we already delivered: suppress
+                    self._count("dedup_tokens")
+                    if tok != freq.delivered[i]:
+                        # sampling replay re-drew; greedy never gets here
+                        self._count("replay_divergence")
+                else:
+                    if freq.t_first is None:
+                        freq.t_first = time.perf_counter()
+                        obs.histogram_observe(
+                            "fleet.ttft_s", freq.t_first - freq.t_submit)
+                    freq.delivered.append(tok)
+        elif kind == "done":
+            estate = ev[2]
+            if estate == "shed" and not freq.aborting:
+                # a replica shedding under pressure is that replica's
+                # problem — re-place on a survivor under the budget
+                self._count("rejects")
+                self._replace(freq, exclude={rep.rid}, reason="reject")
+            else:
+                self._finish(freq, estate,
+                             "finished" if estate == FINISHED else None)
+        elif kind == "reject":
+            self._count("rejects")
+            self._replace(freq, exclude={rep.rid}, reason="reject")
+        elif kind == "handoff":
+            self._replace(freq, exclude={rep.rid}, reason="handoff")
+
+    def _finish(self, freq: FleetRequest, state: str,
+                counter: str | None) -> None:
+        freq.state = state
+        freq.t_done = time.perf_counter()
+        if counter:
+            self._count(counter)
+        if state == FINISHED:
+            obs.histogram_observe("fleet.request_s",
+                                  freq.t_done - freq.t_submit)
+        obs.event("fleet.request", {"fid": freq.fid, "phase": state})
+
+    # -- health --------------------------------------------------------------
+    def _check_health(self) -> None:
+        now = time.monotonic()
+        for name in self.monitor.overdue(now=now):
+            rep = next((r for r in self.replicas if r.name == name), None)
+            if rep is None or not rep.alive:
+                continue
+            # a stale beat alone is not death: on the inline pump a
+            # neighbor's multi-second XLA compile blocks the shared thread,
+            # starving every OTHER replica's beat. Death = the replica WAS
+            # pumped after its last beat and still never beat again — only
+            # kills, hangs and crashes look like that.
+            last_beat = now - self.monitor.age(name, now=now)
+            if rep.t_last_pump > last_beat:
+                self._on_dead(rep, reason="heartbeat")
+
+    def _on_dead(self, rep: EngineReplica, reason: str) -> None:
+        rep.mark_dead()
+        self._count("deaths")
+        obs.event("fleet.replica",
+                  {"rid": rep.rid, "state": DEAD, "reason": reason,
+                   "crash": repr(rep.crash) if rep.crash else None},
+                  level="error")
+        self._refresh_gauges()
+        victims = [f for f in self.requests.values()
+                   if f.replica == rep.rid and f.state not in FLEET_TERMINAL]
+        for freq in victims:
+            self._replace(freq, exclude={rep.rid}, reason="failover")
+
+    # -- accounting ----------------------------------------------------------
+    def _count(self, key: str, n: int = 1) -> None:
+        self.stats[key] = self.stats.get(key, 0) + n
+        obs.counter_inc(f"fleet.{key}", n)
+
+    def _refresh_gauges(self) -> None:
+        by_state = {HEALTHY: 0, DRAINING: 0, DEAD: 0, RETIRED: 0}
+        for rep in self.replicas:
+            by_state[rep.state] += 1
+            obs.gauge_set("fleet.replica_state", STATE_ORDINAL[rep.state],
+                          labels={"rid": str(rep.rid)})
+        obs.gauge_set("fleet.replicas_healthy", by_state[HEALTHY])
+        obs.gauge_set("fleet.replicas_draining", by_state[DRAINING])
+        obs.gauge_set("fleet.replicas_dead", by_state[DEAD])
+
+    def reset_stats(self) -> None:
+        """Measurement boundary (mirrors ServingEngine.reset_stats): zero
+        the router counters and the fleet.* registry series; per-engine
+        serving.* counters reset separately via each engine."""
+        for k in self.stats:
+            self.stats[k] = 0
+        obs.reset("fleet.")

@@ -32,3 +32,22 @@ def test_chaos_explicit_plan_every_local_site(tmp_path):
         steps=6, seed=0, root=str(tmp_path), verbose=False)
     assert {s for s, _ in out["fired"]} == {
         "collective.step", "executor.compile", "ckpt.write"}
+
+
+@pytest.mark.chaos
+def test_chaos_fleet_drill_kill_hang_slowbeat_and_drain():
+    """ISSUE 16 fleet scenarios, sized for tier-1: one kill wave, one hang
+    wave, one heartbeat-starve wave, then a drain-and-retire wave — zero
+    lost requests, zero duplicate tokens, outputs byte-identical to the
+    fault-free oracle, zero leaks on every surviving engine (all asserted
+    inside the drill)."""
+    out = chaos.run_fleet_drill(cycles=3, n_req=3, seed=2, n_replicas=2,
+                                verbose=False)
+    assert len(out["cycles"]) == 3
+    sites = {c["site"] for c in out["cycles"]}
+    assert sites == {"fleet_replica_kill", "fleet_replica_hang",
+                     "fleet_heartbeat_slow"}
+    assert any(c["fired"] for c in out["cycles"]), "no fault ever fired"
+    assert out["stats"]["deaths"] >= 1
+    assert out["stats"]["replay_divergence"] == 0
+    assert out["retired"] == 1

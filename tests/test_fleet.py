@@ -1,0 +1,321 @@
+"""Serving-fleet tests (ISSUE 16): heartbeat health checking, failover
+replay exactness (greedy output byte-identical with and without a
+mid-stream replica kill — including a kill during the speculative-decode
+accept window and a kill of a DRAINING replica), exactly-once token
+delivery through the router ledger, drain-and-retire with zero shed,
+affinity placement with graceful degradation, the per-request failover
+budget, fleet-wide shedding, and the FLAGS_watchdog_scale margin knob."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu import observability as obs
+from paddle_tpu.resilience.faults import fault_scope
+from paddle_tpu.resilience.watchdog import HeartbeatMonitor, watchdog_scale
+from paddle_tpu.serving import (AdmissionRejected, FleetRouter, ServingEngine,
+                                decoder_tiny)
+from paddle_tpu.serving.fleet import DEAD, DRAINING, HEALTHY, RETIRED
+
+
+def _factory(**kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pool_pages", 64)
+    kw.setdefault("max_inflight", 4)
+    kw.setdefault("draft_k", 0)
+    kw.setdefault("seed", 0)
+    return lambda: ServingEngine(decoder_tiny(), **kw)
+
+
+def _prompts(n: int) -> list[list[int]]:
+    rng = np.random.default_rng(7)
+    return [rng.integers(1, 97, size=4 + i % 3).tolist() for i in range(n)]
+
+
+def _oracle(prompts, max_new: int, **engine_kw) -> list[list[int]]:
+    """Fault-free single-engine greedy outputs for the same seed/config —
+    the byte-exactness reference every failover test pins against."""
+    eng = _factory(**engine_kw)()
+    rids = [eng.submit(p, max_new) for p in prompts]
+    eng.run_until_drained()
+    return [eng.result(r) for r in rids]
+
+
+def _serve(fr: FleetRouter, prompts, max_new: int, plan: str | None = None):
+    """Submit + drive to idle (optionally under a fault plan); returns
+    per-prompt delivered streams."""
+    fids = [fr.submit(p, max_new) for p in prompts]
+    if plan is not None:
+        with fault_scope(plan):
+            fr.run_until_idle()
+    else:
+        fr.run_until_idle()
+    assert all(fr.state(f) == "finished" for f in fids), \
+        {f: fr.state(f) for f in fids}
+    return [fr.result(f) for f in fids]
+
+
+def _warm(fr: FleetRouter) -> None:
+    """Compile every replica's programs before any timing-sensitive phase
+    (first steps are seconds of XLA compile; heartbeats must not race
+    that)."""
+    fids = [fr.submit([9, 8, 7], 2) for _ in fr.replicas]
+    fr.run_until_idle()
+    assert all(fr.state(f) == "finished" for f in fids)
+    fr.reset_stats()
+
+
+# -- watchdog generalization (satellite 2) -----------------------------------
+
+def test_watchdog_scale_clamps_and_widens():
+    assert watchdog_scale() == 1.0
+    old = flags.get_flag("watchdog_scale")
+    try:
+        flags.set_flags({"watchdog_scale": 0.25})
+        assert watchdog_scale() == 1.0  # values < 1 clamp up, never tighten
+        flags.set_flags({"watchdog_scale": 3.0})
+        assert watchdog_scale() == 3.0
+        assert HeartbeatMonitor(2.0).deadline_s == pytest.approx(6.0)
+    finally:
+        flags.set_flags({"watchdog_scale": old})
+    assert HeartbeatMonitor(2.0).deadline_s == pytest.approx(2.0)
+
+
+def test_heartbeat_monitor_overdue_and_lifecycle():
+    mon = HeartbeatMonitor(0.05, scale=1.0)
+    mon.register("a", now=0.0)
+    mon.register("b", now=0.0)
+    assert mon.overdue(now=0.04) == []
+    mon.beat("a", now=0.04)
+    assert mon.overdue(now=0.08) == ["b"]  # a beat, b went silent
+    mon.deregister("b")
+    assert mon.overdue(now=10.0) == ["a"]
+    mon.beat("zombie", now=0.0)  # beats from unregistered names are ignored
+    assert mon.age("a", now=0.1) == pytest.approx(0.06)
+    disabled = HeartbeatMonitor(0.0)
+    assert not disabled.enabled
+    disabled.register("x", now=0.0)
+    assert disabled.overdue(now=1e9) == []
+
+
+# -- basic fleet serving -----------------------------------------------------
+
+def test_fleet_matches_single_engine_and_affinity_routes():
+    prompts = _prompts(4)
+    want = _oracle(prompts, 6)
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=30.0) as fr:
+        got = _serve(fr, prompts, 6)
+        # identical resubmission must route to the same (healthy) home
+        again = _serve(fr, prompts, 6)
+    assert got == want
+    assert again == want
+    assert fr.stats["affinity_hits"] == 8
+    assert fr.stats["affinity_misses"] == 0
+    assert fr.stats["deaths"] == 0
+
+
+def test_fleet_wide_shed_vs_single_replica_reject():
+    # one running + one waiting per replica (max_inflight=1) trips the
+    # queue-depth floor on BOTH replicas -> fleet-wide AdmissionRejected
+    fac = _factory(shed_queue_depth=1, max_inflight=1)
+    with FleetRouter(fac, n_replicas=2, heartbeat_s=30.0,
+                     affinity=False) as fr:
+        for p in ([1, 2, 3], [4, 5, 6], [7, 8, 9], [2, 4, 6]):
+            fr.submit(list(p), 12)
+            fr.step()  # admission is async: let the job reach the engine
+        with pytest.raises(AdmissionRejected):
+            fr.submit([7, 7, 7], 4)
+        assert fr.stats["sheds"] == 1
+        fr.run_until_idle()
+    # one overloaded replica only loses the placement: the reject bounces
+    # back and the request re-places on the free replica under the budget
+    with FleetRouter(_factory(shed_queue_depth=1), n_replicas=2,
+                     heartbeat_s=30.0, affinity=False) as fr2:
+        fr2.replicas[0].engine.submit([9, 9, 9, 9], 3)  # pre-load replica0
+        fid = fr2.submit([1, 2, 3], 4)
+        fr2.run_until_idle()
+        assert fr2.state(fid) == "finished"
+        assert fr2.stats["rejects"] >= 1
+        assert fr2.stats["failovers"] >= 1
+
+
+# -- failover determinism (satellite 3) --------------------------------------
+
+def test_failover_mid_stream_kill_is_byte_identical():
+    prompts = _prompts(4)
+    want = _oracle(prompts, 8)
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=0.3,
+                     affinity=False) as fr:
+        _warm(fr)
+        # the kill site fires once, a few pumps in — mid-decode for
+        # whichever replica draws it; nothing is announced
+        got = _serve(fr, prompts, 8, plan="fleet_replica_kill:6")
+    assert got == want, "failover replay must be bitwise-exact under greedy"
+    assert fr.stats["deaths"] == 1
+    assert fr.stats["failovers"] >= 1
+    assert fr.stats["replayed_tokens"] >= 1
+    assert fr.stats["dedup_tokens"] == fr.stats["replayed_tokens"]
+    assert fr.stats["replay_divergence"] == 0
+
+
+def test_failover_kill_in_spec_accept_window_is_byte_identical():
+    # long greedy generations settle into loops the n-gram self-draft picks
+    # up (the test_spec_decode_accepts_on_repetitive_sequences mechanism),
+    # so decode emits multi-token accept windows — and the kill lands while
+    # those windows are mid-flight
+    prompts = _prompts(4)
+    want = _oracle(prompts, 16, draft_k=3)
+    oracle_eng = _factory(draft_k=3)()
+    for p in prompts:
+        oracle_eng.submit(p, 16)
+    oracle_eng.run_until_drained()
+    assert oracle_eng.stats["spec_accepted"] > 0, \
+        "workload must actually exercise the accept window"
+    with FleetRouter(_factory(draft_k=3), n_replicas=2, heartbeat_s=0.3,
+                     affinity=False) as fr:
+        _warm(fr)
+        got = _serve(fr, prompts, 16, plan="fleet_replica_kill:6")
+    assert got == want
+    assert fr.stats["deaths"] == 1
+    assert fr.stats["replay_divergence"] == 0
+
+
+def test_failover_kill_of_draining_replica_is_byte_identical():
+    prompts = _prompts(4)
+    want = _oracle(prompts, 8)
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=0.3,
+                     affinity=False) as fr:
+        _warm(fr)
+        fids = [fr.submit(p, 8) for p in prompts]
+        for _ in range(3):  # get decodes moving on both replicas
+            fr.step()
+        fr.drain(0)
+        fr.kill(0)  # the drain never finishes: replica dies mid-drain
+        fr.run_until_idle()
+        got = [fr.result(f) for f in fids]
+        assert all(fr.state(f) == "finished" for f in fids)
+    assert got == want
+    assert fr.replicas[0].state == DEAD
+    assert fr.stats["deaths"] == 1
+    assert fr.stats["replay_divergence"] == 0
+
+
+def test_hang_is_discovered_and_failed_over_exactly():
+    prompts = _prompts(3)
+    want = _oracle(prompts, 8)
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=0.3,
+                     affinity=False) as fr:
+        _warm(fr)
+        got = _serve(fr, prompts, 8, plan="fleet_replica_hang:6")
+    assert got == want
+    assert fr.stats["deaths"] == 1, \
+        "a wedged replica must be declared dead exactly like a killed one"
+    assert fr.stats["replay_divergence"] == 0
+
+
+def test_one_slow_heartbeat_does_not_kill_a_margined_replica():
+    prompts = _prompts(3)
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=30.0,
+                     affinity=False) as fr:
+        _warm(fr)
+        # one dropped beat against a wide deadline: a loaded host, not a
+        # dead one — the health checker must NOT declare death
+        got = _serve(fr, prompts, 6, plan="fleet_heartbeat_slow:3")
+    assert fr.stats["deaths"] == 0
+    assert fr.stats["failovers"] == 0
+    assert got == _oracle(prompts, 6)
+    # ...while a sustained beat starve against a tight deadline IS death
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=0.15,
+                     affinity=False) as fr2:
+        _warm(fr2)
+        with fault_scope("rand:p=1.0,seed=0,sites=fleet_heartbeat_slow"):
+            deadline = time.monotonic() + 60.0
+            while (fr2.stats["deaths"] < len(fr2.replicas)
+                   and time.monotonic() < deadline):
+                fr2.step()
+                time.sleep(0.002)
+        assert fr2.stats["deaths"] == len(fr2.replicas), \
+            "starving every beat must eventually read as death"
+
+
+# -- drain-and-retire (tentpole c) -------------------------------------------
+
+def test_drain_and_retire_sheds_nothing_and_stamps_duration():
+    prompts = _prompts(6)
+    want = _oracle(prompts, 8)
+    obs.reset("fleet.")
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=30.0,
+                     affinity=False) as fr:
+        _warm(fr)
+        fids = [fr.submit(p, 8) for p in prompts]
+        for _ in range(2):
+            fr.step()
+        fr.drain(0)
+        assert fr.replicas[0].state == DRAINING
+        fr.run_until_idle()
+        got = [fr.result(f) for f in fids]
+        # the drained replica retired clean; every request finished on the
+        # survivor or in place — zero shed, zero failed, byte-exact output
+        assert fr.replicas[0].state == RETIRED
+        assert fr.replicas[1].state == HEALTHY
+        assert got == want
+        assert fr.stats["retires"] == 1
+        assert fr.stats["failed"] == 0
+        assert fr.stats["sheds"] == 0
+        assert fr.stats["deaths"] == 0
+        snap = obs.snapshot()
+        assert snap["histograms"]["fleet.drain_s"]["count"] == 1
+        # draining replicas admit nothing: a new submit lands on replica 1
+        fid = fr.submit([3, 1, 4, 1], 4)
+        fr.run_until_idle()
+        assert fr.requests[fid].replica == 1
+
+
+def test_failover_budget_exhaustion_fails_the_request():
+    with FleetRouter(_factory(), n_replicas=3, heartbeat_s=30.0,
+                     affinity=False, failover_budget=1) as fr:
+        _warm(fr)
+        fid = fr.submit([5, 6, 7, 8], 16)
+        fr.step()
+        first = fr.requests[fid].replica
+        fr.kill(first)  # consumes the whole budget of 1
+        fr.step()
+        second = fr.requests[fid].replica
+        assert second is not None and second != first
+        fr.kill(second)  # past the budget: fail, do NOT hop again
+        fr.run_until_idle()
+        assert fr.state(fid) == "failed"
+        assert fr.stats["failed"] == 1
+        assert fr.stats["failovers"] == 1
+        # an untouched replica remains healthy — failure was budget policy
+        assert any(r.state == HEALTHY for r in fr.replicas)
+
+
+# -- threaded pump topology --------------------------------------------------
+
+def test_threaded_pump_serves_and_survives_kill():
+    prompts = _prompts(4)
+    want = _oracle(prompts, 6)
+    # wide heartbeat: a worker's first pump blocks seconds in XLA compile,
+    # which must not read as death
+    with FleetRouter(_factory(), n_replicas=2, heartbeat_s=60.0,
+                     affinity=False, pump="threads") as fr:
+        fids = [fr.submit(p, 6) for p in prompts]
+        deadline = time.monotonic() + 120.0
+        while (any(fr.state(f) != "finished" for f in fids)
+               and time.monotonic() < deadline):
+            fr.poll()
+            time.sleep(0.005)
+        got = [fr.result(f) for f in fids]
+        assert got == want
+        # administrative kill of a live worker: survivors keep serving
+        fr.kill(0)
+        fid = fr.submit([2, 7, 1, 8], 4)
+        deadline = time.monotonic() + 60.0
+        while (fr.state(fid) != "finished"
+               and time.monotonic() < deadline):
+            fr.poll()
+            time.sleep(0.005)
+        assert fr.state(fid) == "finished"
+        assert fr.requests[fid].replica == 1

@@ -20,6 +20,8 @@ acceptance bar, printed per rate.
     python tools/_serve_ab.py --rates 4,16,64 --requests 64
     python tools/_serve_ab.py --shared-prefix --ab  # the ISSUE 11 verdict
     python tools/_serve_ab.py --pool-pages 64       # pressure the pool
+    python tools/_serve_ab.py --fleet               # the ISSUE 16 fleet
+                                                    # campaign (4 arms)
 
 Each rate prints one JSON line; the last line is the sweep summary.
 """
@@ -409,6 +411,242 @@ def overload_block(on_tpu: bool, seed: int = 0) -> dict:
     }
 
 
+def _drive_fleet(fr, workload, max_steps: int = 400_000,
+                 kill_at_frac: float | None = None,
+                 drain_at_frac: float | None = None):
+    """Open-loop driver over a FleetRouter: same arrival honesty as _drive,
+    but submits route through fleet placement and progress comes from
+    step()/poll(). Optionally sigkills the most-loaded replica (silently —
+    the router must DISCOVER it) or begins a drain once `frac` of the
+    requests have finished. Returns (fids, wall_s, event_rid)."""
+    from paddle_tpu.serving.fleet import FLEET_TERMINAL
+
+    pending = deque(sorted(workload))
+    fids = []
+    event_rid = None
+    threaded = fr.pump == "threads"
+    t0 = time.perf_counter()
+    steps = 0
+    n_total = len(workload)
+
+    def _n_done():
+        return sum(1 for f in fids
+                   if fr.requests[f].state in FLEET_TERMINAL)
+
+    while pending or any(fr.requests[f].state not in FLEET_TERMINAL
+                         for f in fids):
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            _, prompt, max_new = pending.popleft()
+            fids.append(fr.submit(prompt, max_new))
+        # the event trigger runs DURING the arrival stream (not after it:
+        # requests complete between arrivals, so by the time the queue is
+        # empty ~everything is finished and nothing would be mid-stream)
+        if event_rid is None:
+            done_frac = _n_done() / max(n_total, 1)
+            if kill_at_frac is not None and done_frac >= kill_at_frac:
+                # the kill must be MEANINGFUL: land on a replica whose
+                # in-flight requests have already streamed tokens, so the
+                # replay/dedup path actually engages (a victim still in
+                # prefill replays nothing and proves nothing). The router
+                # ledger lags the engine by the outbox, so require a stream
+                # nearer its start than its end — otherwise the engine may
+                # already have finished it and only an empty queued request
+                # would fail over. Defer until such a moment; near the end
+                # give up and take the most-loaded so the arm always dies.
+                def _mid_decode(r):
+                    return sum(len(q.delivered) for q in fr.requests.values()
+                               if q.replica == r.rid
+                               and q.state not in FLEET_TERMINAL
+                               and 1 <= len(q.delivered)
+                               <= q.max_new_tokens // 2)
+                alive = [r for r in fr.replicas if r.alive]
+                victim = max(alive, key=lambda r: (_mid_decode(r), r.load()),
+                             default=None)
+                if victim is not None and (_mid_decode(victim) >= 4
+                                           or done_frac >= 0.75):
+                    victim.sigkill()  # silent: heartbeat discovery only
+                    event_rid = victim.rid
+            elif drain_at_frac is not None and done_frac >= drain_at_frac:
+                cands = [r for r in fr.replicas if r.state == "healthy"]
+                if len(cands) > 1:
+                    event_rid = max(cands, key=lambda r: r.load()).rid
+                    fr.drain(event_rid)
+        progressed = fr.poll() if threaded else fr.step()
+        if not progressed:
+            time.sleep(0.0005)
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(f"fleet open loop did not settle in "
+                               f"{max_steps} iterations")
+    return fids, time.perf_counter() - t0, event_rid
+
+
+def _fleet_arm_metrics(fr, fids, wall: float) -> dict:
+    """Per-arm accounting off the router's ledger + stamps: delivered
+    tokens/s, lost/duplicate counts (the hard zeros the gate enforces),
+    TTFT percentiles, and zero-leak checks on every non-dead engine (a
+    SIGKILLed replica's pool is gone with its host — auditing it would be
+    reading freed memory)."""
+    reqs = [fr.requests[f] for f in fids]
+    done = [r for r in reqs if r.state == "finished"]
+    ttft = [r.t_first - r.t_submit for r in done if r.t_first is not None]
+    lat = [r.t_done - r.t_submit for r in done if r.t_done is not None]
+    tokens = sum(len(r.delivered) for r in done)
+    leaked = sum(rep.engine.leaked_pages() for rep in fr.replicas
+                 if rep.state != "dead")
+    return {
+        "requests": len(reqs),
+        "finished": len(done),
+        "lost": sum(1 for r in reqs if r.state == "failed"),
+        "shed": sum(1 for r in reqs if r.state == "shed"),
+        "delivered_tokens": tokens,
+        "wall_s": round(wall, 4),
+        "tok_s": round(tokens / wall, 2) if wall else 0.0,
+        "ttft": _timing.latency_stats(ttft),
+        "request_latency": _timing.latency_stats(lat),
+        "deaths": fr.stats["deaths"],
+        "failovers": fr.stats["failovers"],
+        "handoffs": fr.stats["handoffs"],
+        "retires": fr.stats["retires"],
+        "replayed_tokens": fr.stats["replayed_tokens"],
+        "dedup_tokens": fr.stats["dedup_tokens"],
+        "duplicate_tokens": (fr.stats["replayed_tokens"]
+                             - fr.stats["dedup_tokens"]),
+        "replay_divergence": fr.stats["replay_divergence"],
+        "affinity_hits": fr.stats["affinity_hits"],
+        "affinity_misses": fr.stats["affinity_misses"],
+        "kv_pages_leaked": leaked,
+    }
+
+
+def _fleet_warm(fr, workload) -> None:
+    """The fleet analog of run_open_loop's warmup: precompile each
+    replica's decode lattice, then replay the trace (arrivals collapsed)
+    until two consecutive compile-free passes so the measured arm times
+    engines, not XLA. Health checking is suspended for the duration — a
+    replica joins the heartbeat-checked pool only once warmed (a worker
+    thread blocked seconds inside a legitimate compile must not read as a
+    death; production fleets gate readiness the same way)."""
+    from paddle_tpu.pipeline import jit_compile_counter
+
+    horizon = max(len(p) + mn for _, p, mn in workload)
+    for rep in fr.replicas:
+        rep.engine.warmup_decode(horizon)
+    saved_deadline = fr.monitor.deadline_s
+    fr.monitor.deadline_s = 1e9
+    try:
+        clean = 0
+        for _ in range(8):
+            with jit_compile_counter() as compiles:
+                fids = [fr.submit(p, mn) for _, p, mn in workload]
+                fr.run_until_idle()
+            clean = clean + 1 if compiles.count == 0 else 0
+            if clean >= 2:
+                break
+        assert all(fr.state(f) == "finished" for f in fids)
+    finally:
+        for rep in fr.replicas:
+            if rep.alive:
+                fr.monitor.beat(rep.name)  # fresh stamps before re-arming
+        fr.monitor.deadline_s = saved_deadline
+    fr.reset_stats()
+
+
+def fleet_block(on_tpu: bool, seed: int = 0, n_replicas: int = 4) -> dict:
+    """The ISSUE 16 acceptance campaign — four arms over the same seeded
+    trace:
+
+      single   1 replica, the scaling yardstick
+      fleet4   n_replicas healthy replicas, threaded pumps (the serving
+               topology); tok/s over `single` is the scaling ratio
+      kill     same fleet, the most-loaded replica SIGKILLed (silently)
+               mid-pass once ~25% of requests finished — zero lost
+               requests, zero duplicate tokens, p99 TTFT within 2x of the
+               healthy arm is the gate line
+      drain    same fleet, drain-and-retire of the most-loaded replica
+               mid-pass — zero shed, the retire must complete
+
+    Records `cores`: on a box with fewer cores than replicas the threaded
+    arms timeshare one silicon and the >=3x scaling floor is physically
+    meaningless, so tools/gate.py switches to a CPU-overhead floor there
+    (the multichip precedent)."""
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+
+    cfg, prompt_lens, _ = ab_config(on_tpu, shared_prefix=False)
+    if on_tpu:
+        eng_kw = dict(page_size=16, pool_pages=1024, max_inflight=16)
+        n_req, max_new, rate = 64, 16, 32.0
+    else:
+        eng_kw = dict(page_size=4, pool_pages=64, max_inflight=4)
+        # max_new long enough that decodes span many pumps: the kill arm
+        # needs a mid-stream victim (see _drive_fleet) for replay to engage
+        n_req, max_new, rate = 24, 24, 16.0
+    eng_kw.update(prefix_cache=True, draft_k=0, seed=seed)
+
+    def factory():
+        return ServingEngine(cfg, **eng_kw)
+
+    wl = synth_workload(n_req, cfg.vocab_size, seed=seed,
+                        prompt_lens=prompt_lens, max_new=max_new, rate=rate)
+    # heartbeat tight enough that the kill arm's discovery lands inside the
+    # measured pass, wide enough that a loaded-box scheduling stall on a
+    # threaded pump is not read as death (warmup keeps compiles out)
+    hb = 0.5
+
+    def run_arm(n, pump, **drive_kw):
+        with FleetRouter(factory, n_replicas=n, heartbeat_s=hb,
+                         pump=pump) as fr:
+            _fleet_warm(fr, wl)
+            fids, wall, rid = _drive_fleet(fr, wl, **drive_kw)
+            if drive_kw.get("drain_at_frac") is not None and rid is not None:
+                # the drive settles when requests do; spin until the retire
+                # itself is observed (it needs a few more polls)
+                deadline = time.perf_counter() + 30.0
+                while (fr.stats["retires"] == 0
+                       and time.perf_counter() < deadline):
+                    fr.poll() if pump == "threads" else fr.step()
+                    time.sleep(0.001)
+            out = _fleet_arm_metrics(fr, fids, wall)
+            out["event_rid"] = rid
+            return out
+
+    pump = "threads"
+    arms = {
+        "single": run_arm(1, pump),
+        "fleet4": run_arm(n_replicas, pump),
+        # the kill arm pumps INLINE: on the threaded pump the router ledger
+        # lags the engine by the outbox (under the GIL the whole decode can
+        # finish before the ledger shows one token), so only the inline pump
+        # can deterministically land the SIGKILL on a mid-stream victim —
+        # which is the entire point of the arm. Discovery semantics are pump-
+        # agnostic: the heartbeat deadline, not the pump, declares death.
+        "kill": run_arm(n_replicas, "inline", kill_at_frac=0.25),
+        "drain": run_arm(n_replicas, pump, drain_at_frac=0.25),
+    }
+
+    def _ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    p99_h = arms["fleet4"]["ttft"]["p99_ms"]
+    p99_k = arms["kill"]["ttft"]["p99_ms"]
+    return {
+        "arms": arms,
+        "n_replicas": n_replicas,
+        "cores": os.cpu_count(),
+        "heartbeat_s": hb,
+        "scaling_vs_single": _ratio(arms["fleet4"]["tok_s"],
+                                    arms["single"]["tok_s"]),
+        "kill_ttft_p99_ratio": (_ratio(p99_k, p99_h)
+                                if p99_h and p99_k else None),
+        "kill_lost": arms["kill"]["lost"],
+        "kill_duplicate_tokens": arms["kill"]["duplicate_tokens"],
+        "drain_shed": arms["drain"]["shed"],
+        "drain_retired": arms["drain"]["retires"],
+        "config": f"n{n_req} max_new{max_new} r{rate:g} seed{seed}",
+    }
+
+
 def ab_config(on_tpu: bool, shared_prefix: bool):
     """(cfg, prompt_lens, user_lens) for the sweep. The shared-prefix CPU
     config is deliberately LESS tiny than decoder_tiny: at decoder_tiny
@@ -479,11 +717,22 @@ def main():
                     help="run the ISSUE 14 three-arm overload block "
                          "(unloaded / 10x with shedding / 10x under "
                          "faults) and print its JSON")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the ISSUE 16 four-arm fleet block (single / "
+                         "healthy fleet / mid-pass SIGKILL / drain-and-"
+                         "retire) and print its JSON")
+    ap.add_argument("--replicas", type=int, default=4,
+                    help="fleet size for --fleet (default 4)")
     args = ap.parse_args()
     if args.prefix_cache is not None:
         args.prefix_cache = bool(args.prefix_cache)
     if args.overload:
         print(json.dumps(overload_block(on_tpu, seed=args.seed)),
+              flush=True)
+        return
+    if args.fleet:
+        print(json.dumps(fleet_block(on_tpu, seed=args.seed,
+                                     n_replicas=args.replicas)),
               flush=True)
         return
 

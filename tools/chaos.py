@@ -20,6 +20,11 @@ plan string.
                                     # under FLAGS_guard_numerics — the
                                     # epoch must finish finite with the
                                     # poisoned updates skipped in-graph
+    python tools/chaos.py --fleet   # fleet drill: kill / hang / slow-
+                                    # heartbeat waves + drain-and-retire
+                                    # over the replica fleet; zero lost
+                                    # requests, zero duplicate tokens,
+                                    # byte-exact greedy outputs
 
 Exit code 0 = survived + trajectory matched; 1 = divergence or crash.
 The `chaos` pytest marker (tests/test_chaos.py, tests/test_liveness.py)
@@ -253,6 +258,113 @@ def run_serve_drill(cycles: int = 3, n_req: int = 6, p: float = 0.08,
             "leaked_pages": snap["leaked_pages"]}
 
 
+def run_fleet_drill(cycles: int = 3, n_req: int = 6, seed: int = 0,
+                    n_replicas: int = 3, verbose: bool = False) -> dict:
+    """Fleet-resilience drill (ISSUE 16): drive the replica fleet through
+    `cycles` waves of requests, each wave under a different seeded fleet
+    fault scenario — kill (SIGKILL-style silent death), hang (wedged pump,
+    no beats), and sparse slow-heartbeat blips the margined deadline
+    must ride out without a death verdict — plus a drain-and-retire
+    wave. Every wave must end with ZERO lost requests (every submit
+    reaches a clean terminal state), ZERO duplicate token positions (the
+    router ledger is append-only by construction, checked via
+    dedup/divergence counters), greedy outputs byte-identical to the
+    fault-free single-engine oracle, and zero pages leaked on every
+    surviving engine. Returns per-cycle fired faults and fleet stats."""
+    from paddle_tpu.resilience import fault_scope
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving import model as sv_model
+
+    def factory():
+        return ServingEngine(sv_model.decoder_tiny(), page_size=4,
+                             pool_pages=64, max_inflight=4, seed=seed,
+                             prefix_cache=True, draft_k=0)
+
+    rng = np.random.default_rng(seed)
+    waves = []
+    for cycle in range(cycles):
+        prompts = [rng.integers(1, 97, size=int(rng.integers(3, 8))).tolist()
+                   for _ in range(n_req)]
+        max_new = int(rng.integers(4, 9))
+        waves.append((prompts, max_new))
+
+    # fault-free oracle: one engine, same seed — the byte-exactness pin
+    oracle = factory()
+    want = []
+    for prompts, max_new in waves:
+        rids = [oracle.submit(p, max_new) for p in prompts]
+        oracle.run_until_drained()
+        want.append([oracle.result(r) for r in rids])
+        oracle.prune_finished()
+
+    scenarios = ["fleet_replica_kill", "fleet_replica_hang",
+                 "fleet_heartbeat_slow"]
+    cycles_out = []
+    fr = FleetRouter(factory, n_replicas=n_replicas, heartbeat_s=0.3,
+                     affinity=False)
+    # compile pass so fault timing hits warmed replicas, not XLA compiles
+    warm = [fr.submit([9, 8, 7], 2) for _ in range(n_replicas)]
+    fr.run_until_idle()
+    assert all(fr.state(f) == "finished" for f in warm)
+    fr.reset_stats()
+    for cycle, (prompts, max_new) in enumerate(waves):
+        site = scenarios[cycle % len(scenarios)]
+        alive_before = sum(1 for r in fr.replicas if r.alive)
+        if alive_before <= 1:
+            fr.add_replica()  # keep a survivor to fail over onto
+        # one mid-wave hit for kill/hang. Slow-beat gets SPARSE explicit
+        # drops (isolated loaded-host blips, beats in between): the margined
+        # deadline must ride them out with zero deaths — a total starve is
+        # legitimate fleet-wide death and would (correctly) lose requests,
+        # which is the sustained-starve unit test's job, not the drill's
+        plan = ("fleet_heartbeat_slow:3,7,11,15"
+                if site == "fleet_heartbeat_slow"
+                else f"{site}:{4 + 2 * cycle}")
+        fids = [fr.submit(p, max_new) for p in prompts]
+        with fault_scope(plan) as fp:
+            fr.run_until_idle()
+            fired = list(fp.stats()["fired"])
+        states = {f: fr.state(f) for f in fids}
+        lost = {f: s for f, s in states.items() if s != "finished"}
+        assert not lost, f"cycle {cycle} ({site}): lost requests {lost}"
+        got = [fr.result(f) for f in fids]
+        assert got == want[cycle], (
+            f"cycle {cycle} ({site}): delivered streams diverged from the "
+            f"fault-free oracle")
+        assert fr.stats["replay_divergence"] == 0, \
+            "greedy replay must never disagree with the delivered ledger"
+        for rep in fr.replicas:
+            if rep.alive:
+                leaked = rep.engine.leaked_pages()
+                assert leaked == 0, (
+                    f"cycle {cycle}: replica {rep.rid} leaked {leaked}")
+        if verbose:
+            print(f"cycle {cycle}: site={site} fired={fired} "
+                  f"deaths={fr.stats['deaths']} "
+                  f"failovers={fr.stats['failovers']} "
+                  f"dedup={fr.stats['dedup_tokens']}")
+        cycles_out.append({"site": site, "plan": plan, "fired": fired,
+                           "states": {"finished": len(fids)}})
+    # final wave: drain-and-retire a live replica mid-traffic — zero shed
+    healthy = [r.rid for r in fr.replicas if r.state == "healthy"]
+    if len(healthy) < 2:
+        fr.add_replica()
+        healthy = [r.rid for r in fr.replicas if r.state == "healthy"]
+    prompts, max_new = waves[0]
+    fids = [fr.submit(p, max_new) for p in prompts]
+    for _ in range(2):
+        fr.step()
+    fr.drain(healthy[0])
+    fr.run_until_idle()
+    assert all(fr.state(f) == "finished" for f in fids), \
+        "drain-and-retire must shed nothing"
+    assert [fr.result(f) for f in fids] == want[0]
+    out = {"cycles": cycles_out, "stats": dict(fr.stats),
+           "retired": sum(1 for r in fr.replicas if r.state == "retired")}
+    fr.shutdown()
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -279,7 +391,26 @@ def main(argv=None) -> int:
                          "serving_pool_corrupt / serving_deadline; every "
                          "cycle must drain leak-free with a clean pool "
                          "audit and clean terminal states")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet-resilience drill: kill / hang / "
+                         "slow-heartbeat waves plus drain-and-retire over "
+                         "the replica fleet; zero lost requests, zero "
+                         "duplicate tokens, byte-exact greedy outputs")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        try:
+            out = run_fleet_drill(seed=args.seed, verbose=True)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"FLEET DRILL FAILED: {e}", file=sys.stderr)
+            return 1
+        s = out["stats"]
+        print(f"OK: fleet served {len(out['cycles'])} faulted wave(s) + "
+              f"drain — {s['deaths']} death(s), {s['failovers']} "
+              f"failover(s), {s['replayed_tokens']} replayed / "
+              f"{s['dedup_tokens']} deduped token(s), 0 divergence, "
+              f"{out['retired']} retired clean")
+        return 0
 
     if args.serve:
         try:

@@ -28,6 +28,10 @@ every end-of-round snapshot commit:
                                            # keys, and the newest bench's
                                            # learned fallback rate must stay
                                            # under the ceiling
+    python tools/gate.py --fleet [F.json]  # serving-fleet campaign artifact
+                                           # only (SIGKILL arm hard zeros,
+                                           # scaling floor, drain-and-retire,
+                                           # bounded kill-arm TTFT)
 """
 from __future__ import annotations
 
@@ -136,6 +140,31 @@ MC_EFFICIENCY_FLOOR = 0.5
 # (async dispatch drain, serving scheduler), so its measured cost over the
 # legacy accumulators must stay ~free — same ceiling as the health sentinel
 OBS_OVERHEAD_CEIL_PCT = 2.0
+
+# serving fleet (ISSUE 16, `gate.py --fleet` over FLEET_r*.json). The hard
+# zeros are unconditional: a SIGKILL mid-decode may lose NO requests and
+# deliver NO duplicate tokens (the router ledger is exactly-once), the
+# drain arm may shed nothing, and no surviving engine may leak a page.
+# Scaling: 1 -> N replicas must deliver >= FLEET_SCALING_FLOOR x tok/s —
+# but only where the box has at least one core per replica; on a smaller
+# box the threaded replicas timeshare one silicon and the honest floor is
+# "the fleet machinery costs bounded overhead" (the multichip CPU-mesh
+# precedent), FLEET_CPU_OVERHEAD_FLOOR of the single arm.
+FLEET_SCALING_FLOOR = 3.0
+FLEET_CPU_OVERHEAD_FLOOR = 0.7
+# the kill arm's p99 TTFT may not blow past this multiple of the healthy
+# fleet arm's: discovery + replay must cost a heartbeat deadline, not a
+# queueing collapse (ISSUE 16 acceptance line). Death discovery is bounded
+# below by the configured heartbeat deadline — a fixed constant, not a
+# performance property — so the ceiling is applied AFTER granting the kill
+# arm an explicit detection budget of FLEET_DETECT_BUDGET_BEATS heartbeat
+# intervals (deadline + check cadence + replay dispatch + requeue behind
+# the survivor's admission window). On hardware where
+# step time dominates the heartbeat the budget is negligible and the pure
+# ratio governs; on a CPU box with ~10ms TTFTs it keeps the check honest
+# instead of impossible.
+FLEET_TTFT_CEIL_RATIO = 2.0
+FLEET_DETECT_BUDGET_BEATS = 4.0
 
 
 def run_suite() -> int:
@@ -623,6 +652,116 @@ def check_multichip(path: str | None = None) -> int:
     return rc
 
 
+def check_fleet(path: str | None = None) -> int:
+    """`--fleet`: gate the newest (or given) FLEET_r*.json campaign
+    artifact (ISSUE 16, tools/_serve_ab.py --fleet). Hard zeros first —
+    lost requests / duplicate tokens under the mid-pass SIGKILL, shed
+    requests under drain-and-retire, leaked pages on any surviving engine
+    — then the scaling floor (CPU-adjusted when the box has fewer cores
+    than replicas) and the kill arm's bounded p99 TTFT. The kill arm must
+    actually have exercised the machinery: >= 1 discovered death and >= 1
+    replayed token, or the artifact measured nothing."""
+    arts = sorted(glob.glob(os.path.join(REPO, "FLEET_r*.json")))
+    if path is None:
+        if not arts:
+            print("[gate] WARN: no FLEET_r*.json artifact", flush=True)
+            return 0
+        path = arts[-1]
+    label = os.path.basename(path)
+    try:
+        with open(path) as f:
+            text = f.read()
+        data = json.loads(text)
+    except (OSError, ValueError) as e:
+        print(f"[gate] WARN: cannot read fleet artifact {path}: {e}",
+              flush=True)
+        return 0
+    if not isinstance(data, dict) or "arms" not in data:
+        print(f"[gate] WARN: {label} carries no fleet arms — skipped",
+              flush=True)
+        return 0
+    rc = 0
+    arms = data.get("arms") or {}
+    for arm, row in sorted(arms.items()):
+        if row.get("kv_pages_leaked"):
+            print(f"[gate] FAIL: fleet arm '{arm}' leaked "
+                  f"{row['kv_pages_leaked']} KV pages on a surviving "
+                  f"engine — a failover/drain path lost pages", flush=True)
+            rc = 1
+        if row.get("replay_divergence"):
+            print(f"[gate] FAIL: fleet arm '{arm}' recorded "
+                  f"{row['replay_divergence']} diverging replayed tokens "
+                  f"under greedy — batch-composition invariance broke",
+                  flush=True)
+            rc = 1
+    kill = arms.get("kill") or {}
+    print(f"[gate] fleet {label}: single {arms.get('single', {}).get('tok_s')}"
+          f" -> fleet {arms.get('fleet4', {}).get('tok_s')} tok/s "
+          f"(x{data.get('scaling_vs_single')}, {data.get('n_replicas')} "
+          f"replicas on {data.get('cores')} cores); kill arm lost "
+          f"{data.get('kill_lost')}, dup {data.get('kill_duplicate_tokens')}"
+          f", ttft p99 x{data.get('kill_ttft_p99_ratio')}; drain shed "
+          f"{data.get('drain_shed')}, retired {data.get('drain_retired')}",
+          flush=True)
+    if data.get("kill_lost"):
+        print(f"[gate] FAIL: the SIGKILL arm LOST {data['kill_lost']} "
+              f"requests — failover replay must finish every in-flight "
+              f"request on a survivor", flush=True)
+        rc = 1
+    if data.get("kill_duplicate_tokens"):
+        print(f"[gate] FAIL: the SIGKILL arm delivered "
+              f"{data['kill_duplicate_tokens']} duplicate tokens — the "
+              f"router ledger's exactly-once dedup regressed", flush=True)
+        rc = 1
+    if not kill.get("deaths") or not kill.get("replayed_tokens"):
+        print(f"[gate] FAIL: the kill arm discovered "
+              f"{kill.get('deaths')} deaths / replayed "
+              f"{kill.get('replayed_tokens')} tokens — the fault never "
+              f"engaged, the artifact measured nothing", flush=True)
+        rc = 1
+    if data.get("drain_shed"):
+        print(f"[gate] FAIL: drain-and-retire shed {data['drain_shed']} "
+              f"requests — a planned migration must hand work off, not "
+              f"drop it", flush=True)
+        rc = 1
+    if not data.get("drain_retired"):
+        print("[gate] FAIL: the drain arm never observed the retire — "
+              "the DRAINING replica did not empty out", flush=True)
+        rc = 1
+    scaling = data.get("scaling_vs_single")
+    cores = data.get("cores") or 0
+    n_rep = data.get("n_replicas") or 1
+    if scaling is not None:
+        if cores >= n_rep and scaling < FLEET_SCALING_FLOOR:
+            print(f"[gate] FAIL: 1 -> {n_rep} replicas scaled tok/s only "
+                  f"{scaling}x (floor {FLEET_SCALING_FLOOR}) with "
+                  f"{cores} cores available — the router/pump layer is "
+                  f"serializing the fleet", flush=True)
+            rc = 1
+        elif cores < n_rep and scaling < FLEET_CPU_OVERHEAD_FLOOR:
+            print(f"[gate] FAIL: on {cores} core(s) the {n_rep}-replica "
+                  f"fleet delivers {scaling}x the single replica (floor "
+                  f"{FLEET_CPU_OVERHEAD_FLOOR}) — fleet overhead is eating "
+                  f"the engine, beyond honest timesharing", flush=True)
+            rc = 1
+    kill_p99 = ((kill.get("ttft") or {}).get("p99_ms"))
+    healthy_p99 = (((arms.get("fleet4") or {}).get("ttft") or {})
+                   .get("p99_ms"))
+    if kill_p99 is not None and healthy_p99 is not None:
+        detect_ms = FLEET_DETECT_BUDGET_BEATS * 1000.0 \
+            * float(data.get("heartbeat_s") or 0.0)
+        ceil_ms = FLEET_TTFT_CEIL_RATIO * healthy_p99 + detect_ms
+        if kill_p99 > ceil_ms:
+            print(f"[gate] FAIL: the kill arm's p99 TTFT is {kill_p99}ms vs "
+                  f"a ceiling of {FLEET_TTFT_CEIL_RATIO}x the healthy fleet "
+                  f"arm ({healthy_p99}ms) + a {detect_ms:g}ms detection "
+                  f"budget — death discovery/replay is stalling admitted "
+                  f"traffic beyond the heartbeat deadline it must cost",
+                  flush=True)
+            rc = 1
+    return rc
+
+
 def _check_obs(data: dict, label: str, require: bool = False) -> int:
     """Telemetry-block gate (ISSUE 13). Three failure modes:
       * missing block (only when `require` — artifacts predating the layer
@@ -860,6 +999,9 @@ def main() -> int:
         return check_kernel_registry()
     if "--costmodel" in sys.argv:
         return check_costmodel()
+    if "--fleet" in sys.argv:
+        arg = sys.argv[sys.argv.index("--fleet") + 1:]
+        return check_fleet(arg[0] if arg else None)
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
@@ -867,6 +1009,7 @@ def main() -> int:
         rc = rc or check_bench()
         rc = rc or check_multichip()
         rc = rc or check_costmodel()
+        rc = rc or check_fleet()
     if rc == 0:
         print("[gate] OK — green suite, safe to snapshot")
     return rc
