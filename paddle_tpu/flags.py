@@ -379,6 +379,22 @@ _define("fleet_affinity_tokens", 16,
         "prompt-head length (tokens) hashed for affinity placement; "
         "prompts shorter than this hash whole. Align to the page size so "
         "requests sharing cached pages share a routing key")
+# disaggregated prefill/decode serving (serving/fleet/handoff.py — see
+# README "Disaggregated serving")
+_define("disagg_prefill_replicas", 0,
+        "split the fleet into roles: the first N replicas become "
+        "prefill-only engines and the rest decode engines, all over ONE "
+        "shared PagedKVPool, with prefill->decode KV handoff via TTL'd "
+        "leases (FleetRouter roles= overrides; must leave at least one "
+        "decode replica). 0 = co-located serving, every replica does both "
+        "stages")
+_define("disagg_lease_ttl_s", 2.0,
+        "KV handoff lease time-to-live in seconds: a PREPARED lease whose "
+        "commit has not arrived within the TTL is reaped — its page pin "
+        "returns to the shared pool and the router replays the prompt "
+        "under the normal failover budget. Scaled by FLAGS_watchdog_scale "
+        "(slow CI must not reap healthy handoffs); commits that lose the "
+        "expiry race are rejected atomically, never half-adopted")
 # tiered giant-embedding knobs (paddle_tpu/embedding/, the minimize()-time
 # rewrite in passes.rewrite_tiered_embeddings — see README "Tiered
 # embeddings")

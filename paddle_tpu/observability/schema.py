@@ -92,6 +92,12 @@ DECLARED: list[tuple] = [
     ("serving.recovery.quarantined", COUNTER,
      "poisoned requests quarantined (aborted, pages forfeited) by "
      "recovery", ()),
+    ("serving.handoff_extracts", COUNTER,
+     "prefilled requests extracted HANDED_OFF for disaggregated "
+     "prefill->decode transfer (ISSUE 19)", ()),
+    ("serving.adopts", COUNTER,
+     "lease-transferred requests adopted mid-decode from a prefill "
+     "engine (prefill skipped entirely)", ()),
     ("serving.ladder.spec_off", COUNTER,
      "degradation-ladder climbs to rung 1: speculative decode off", ()),
     ("serving.ladder.lookahead_shrink", COUNTER,
@@ -163,6 +169,41 @@ DECLARED: list[tuple] = [
     ("fleet.request", EVENT,
      "fleet request lifecycle record (placed/finished/failed/rejected/"
      "budget_exhausted/unplaceable)", ()),
+    # -- disaggregated prefill/decode handoff (serving/fleet/handoff.py,
+    #    ISSUE 19) -----------------------------------------------------------
+    ("fleet.prefill_dispatches", COUNTER,
+     "prompts dispatched to a prefill-role replica (disaggregated "
+     "placement: decode home chosen, prefill stage runs first)", ()),
+    ("fleet.handoff.prepared", COUNTER,
+     "prefill->decode handoffs published under a lease (PREPARE)", ()),
+    ("fleet.handoff.committed", COUNTER,
+     "handoffs adopted by a decode engine (COMMIT: lease refcount "
+     "transferred, decode resumes mid-request)", ()),
+    ("fleet.handoff.commit_failed", COUNTER,
+     "commits rejected: unknown lease, double commit, expiry race, or a "
+     "draining/bouncing adopter", ()),
+    ("fleet.handoff.released", COUNTER,
+     "post-commit prefill-pin releases confirmed to the prefill side", ()),
+    ("fleet.handoff.dropped", COUNTER,
+     "prepared messages lost in flight (disagg_handoff_drop site): the "
+     "lease stays published and the reaper recovers it at TTL", ()),
+    ("fleet.handoff.replays", COUNTER,
+     "handed-off requests replayed from the prompt (reaped lease, failed "
+     "commit, or a death mid-handoff)", ()),
+    ("fleet.handoff.s", HISTOGRAM,
+     "handoff latency: lease PREPARE -> decode COMMIT", ()),
+    ("fleet.handoff", EVENT,
+     "handoff lifecycle record (prepared/committed/reaped/abandoned)", ()),
+    ("fleet.lease.granted", COUNTER,
+     "KV leases granted (page tables pinned in the shared pool)", ()),
+    ("fleet.lease.reaped", COUNTER,
+     "leases reclaimed: TTL expiry, abandonment, or expiry at commit", ()),
+    ("fleet.lease.expired_at_commit", COUNTER,
+     "commits that lost the expiry race (rejected atomically; the "
+     "request replays)", ()),
+    ("fleet.lease.active", GAUGE, "leases currently PREPARED", ()),
+    ("fleet.lease.pinned_pages", GAUGE,
+     "shared-pool pages currently pinned by leases (in transit)", ()),
     # -- training step telemetry (executor.py async window) -----------------
     ("train.steps", COUNTER, "async steps drained to completion", ()),
     ("train.step_latency_s", HISTOGRAM,

@@ -84,6 +84,30 @@ host, reproducibly. This module plants named *sites* in the hot paths —
                       x FLAGS_watchdog_scale) must NOT declare the replica
                       dead; a scheduled run of hits starves the monitor
                       into a (correct) death verdict
+    disagg_prefill_kill
+                      EngineReplica.pump_once, prefill-role replicas only
+                      (disaggregated serving, ISSUE 19) — the prefill
+                      replica dies SIGKILL-style exactly like
+                      fleet_replica_kill; requests mid-prefill (or whose
+                      lease never published) must replay on a surviving
+                      prefill replica within the fleet_policy budget,
+                      while already-published leases survive the death
+                      (the shared pool, not the dead host, owns the pin)
+                      and still commit
+    disagg_handoff_drop
+                      FleetRouter handling of a "prepared" event — the
+                      event is dropped on the floor: the lease is
+                      published and pinned but the commit is never
+                      dispatched (a lost message between the stages), so
+                      the lease REAPER must reclaim the orphaned pin at
+                      TTL and the router must replay the prompt
+    disagg_lease_expire_race
+                      HandoffManager.commit — the lease's expiry is
+                      forced into the past at the exact moment the commit
+                      arrives, so the reap-vs-commit race resolves REAP:
+                      the commit must be rejected atomically (never a
+                      half-adopted table), the pin reclaimed once, and
+                      the request replayed cleanly
     emb_host_stall    the tiered-embedding miss resolver
                       (embedding/engine.resolve_feed) — the host-tier
                       prefetch parks forever (a hung remote shard / page-in
@@ -124,7 +148,8 @@ FAULT_SITES = frozenset({
     "collective_stall", "numeric_nan", "numeric_spike", "serving_abort",
     "emb_host_stall", "serving_step_fail", "serving_pool_corrupt",
     "serving_deadline", "fleet_replica_kill", "fleet_replica_hang",
-    "fleet_heartbeat_slow",
+    "fleet_heartbeat_slow", "disagg_prefill_kill", "disagg_handoff_drop",
+    "disagg_lease_expire_race",
 })
 
 

@@ -131,7 +131,11 @@ def fleet_policy(**overrides) -> RetryPolicy:
     per replica death), and the millisecond backoff paces re-placement
     when every survivor momentarily rejects. AdmissionRejected counts as
     transient here — a shedding replica is a full replica, and another one
-    (or the same one a beat later) may admit."""
+    (or the same one a beat later) may admit. Disaggregated handoff
+    failures (ISSUE 19: a reaped lease, a bounced commit, a death on
+    either side of the prefill->decode transfer) ride this same budget —
+    a replay is a replay, however the request got stranded — while
+    planned drain handoffs stay free."""
     from .. import flags
     from ..serving.engine import AdmissionRejected
 

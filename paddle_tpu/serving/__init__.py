@@ -37,19 +37,21 @@ three-arm shared_prefix A/B) gated by tools/gate.py.
 """
 from .engine import (AdmissionRejected, ContinuousBatchingScheduler,
                      GenRequest, ServingEngine, ngram_draft)
-from .kv_cache import (PagedKVPool, PrefixCache, create_device_pools,
-                       pool_var_names)
+from .kv_cache import (OwnedPoolView, PagedKVPool, PrefixCache,
+                       create_device_pools, pool_var_names)
 from .model import (DecoderConfig, build_decode_program,
                     build_full_forward_program, build_prefill_program,
                     build_window_program, decoder_tiny)
 from .sampling import SamplingParams, sample_token
 from .fleet import (EngineReplica, FleetRequest, FleetRouter,
-                    NoHealthyReplica)
+                    HandoffManager, KVLease, NoHealthyReplica,
+                    disagg_fleet_factory)
 
 __all__ = [
     "EngineReplica", "FleetRouter", "FleetRequest", "NoHealthyReplica",
+    "HandoffManager", "KVLease", "disagg_fleet_factory",
     "ServingEngine", "GenRequest", "ContinuousBatchingScheduler",
-    "AdmissionRejected",
+    "AdmissionRejected", "OwnedPoolView",
     "PagedKVPool", "PrefixCache", "pool_var_names", "create_device_pools",
     "DecoderConfig", "decoder_tiny", "build_prefill_program",
     "build_decode_program", "build_window_program",

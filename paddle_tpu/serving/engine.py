@@ -95,7 +95,8 @@ from ..observability.slo import hist_p99_above
 from ..resilience.faults import InjectedFault, fault_point
 from ..resilience.retry import serving_policy
 from . import model as sv_model
-from .kv_cache import PagedKVPool, PrefixCache, create_device_pools
+from .kv_cache import (OwnedPoolView, PagedKVPool, PrefixCache,
+                       create_device_pools, pool_var_names)
 from .sampling import SamplingParams, request_rng, sample_token
 
 __all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine",
@@ -103,6 +104,10 @@ __all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine",
 
 WAITING, RUNNING, FINISHED, ABORTED = "waiting", "running", "finished", "aborted"
 DEADLINE_EXCEEDED, SHED = "deadline_exceeded", "shed"
+# disaggregated serving (ISSUE 19): the request left this engine through a
+# KV handoff — NOT terminal; its pages stay pinned (the prefill pin) until
+# the adopting side commits and the router sends release_handoff
+HANDED_OFF = "handed_off"
 # the states a request never leaves; pop_result/prune accept any of them
 _TERMINAL = frozenset({FINISHED, ABORTED, DEADLINE_EXCEEDED, SHED})
 # graceful-degradation ladder rungs, mildest first (see _update_ladder)
@@ -252,7 +257,18 @@ class ServingEngine:
                  shed_ttft_p99_ms: float | None = None,
                  degrade_after: int | None = None,
                  step_retries: int | None = None,
-                 audit_every: int | None = None):
+                 audit_every: int | None = None,
+                 shared_pool: "PagedKVPool | None" = None,
+                 shared_scope: "Scope | None" = None,
+                 pool_owner: str | None = None,
+                 prefill_only: bool = False):
+        """Disaggregated serving (ISSUE 19): pass `shared_pool` (ONE
+        `PagedKVPool` spanning the fleet — this engine sees it through an
+        `OwnedPoolView` tagged `pool_owner`) plus `shared_scope` (the
+        device pools and weights every role reads/writes) to build a
+        role-split engine. `prefill_only=True` skips the decode stage of
+        every step: requests prefill, then sit RUNNING until
+        `extract_for_handoff` publishes them to a decode engine."""
         self.cfg = cfg or sv_model.decoder_tiny()
         self.page_size = int(page_size
                              or flags.get_flag("serving_page_size"))
@@ -315,10 +331,22 @@ class ServingEngine:
         self._pressure_steps = 0
         self._calm_steps = 0
         self._step_i = 0
-        self.pool = PagedKVPool(self.pool_pages, self.page_size)
+        self.prefill_only = bool(prefill_only)
+        self._shared_pool = shared_pool is not None
+        if shared_pool is not None:
+            if (shared_pool.num_pages != self.pool_pages
+                    or shared_pool.page_size != self.page_size):
+                raise ValueError(
+                    f"shared pool is {shared_pool.num_pages}x"
+                    f"{shared_pool.page_size} but this engine asked for "
+                    f"{self.pool_pages}x{self.page_size}")
+            self.pool = OwnedPoolView(shared_pool,
+                                      pool_owner or f"engine@{id(self)}")
+        else:
+            self.pool = PagedKVPool(self.pool_pages, self.page_size)
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
         self._exe = Executor()
-        self._scope = Scope()
+        self._scope = shared_scope if shared_scope is not None else Scope()
 
         self._mesh = None
         if self.tp > 1:
@@ -353,11 +381,21 @@ class ServingEngine:
                 unique_name.guard():
             self._cow_io = sv_model.build_cow_program(
                 self.cfg, self.pool_pages, self.page_size)
-        self._exe.run(startup, scope=self._scope)
-        create_device_pools(self._scope, self.cfg.num_layers,
-                            self.pool_pages, self.page_size,
-                            self.cfg.num_heads, self.cfg.head_dim,
-                            self.cfg.dtype)
+        # rng_counter pinned to what a FRESH scope's first run folds in:
+        # on a shared scope the run counter has already advanced, and
+        # letting it leak into the init keys would give every engine after
+        # the first different weights — silently breaking replay exactness
+        self._exe.run(startup, scope=self._scope, rng_counter=1)
+        # a shared scope may already carry live KV (an engine added to a
+        # running disaggregated fleet): re-zeroing the pools would clobber
+        # every peer's context, so only the FIRST engine materializes them.
+        # Identically-seeded startup runs make the weight re-init above a
+        # bitwise no-op on a shared scope.
+        if not self._scope.has_var(pool_var_names(self.cfg.num_layers)[0][0]):
+            create_device_pools(self._scope, self.cfg.num_layers,
+                                self.pool_pages, self.page_size,
+                                self.cfg.num_heads, self.cfg.head_dim,
+                                self.cfg.dtype)
         self._prefill_run = self._exec_target(self._prefill_prog)
         self._decode_run = self._exec_target(self._decode_prog)
         self._window_run = self._exec_target(self._window_prog)
@@ -381,6 +419,8 @@ class ServingEngine:
             # resilience (ISSUE 14) — dotted keys mirror to the registry
             # verbatim through _count ("serving." + key)
             "deadline_exceeded": 0, "shed": 0, "rejects": 0,
+            # disaggregated handoff (ISSUE 19)
+            "adopts": 0, "handoff_extracts": 0,
             "step_retries": 0, "recovery.passes": 0,
             "recovery.replayed": 0, "recovery.quarantined": 0,
             "ladder.spec_off": 0, "ladder.lookahead_shrink": 0,
@@ -548,6 +588,107 @@ class ServingEngine:
     def has_work(self) -> bool:
         return bool(self._waiting or self._running)
 
+    @property
+    def decode_slots_free(self) -> int:
+        """RUNNING capacity left under max_inflight — what an adopting
+        replica checks before committing a lease (an adopted request
+        enters RUNNING directly, so it must fit the decode batch NOW)."""
+        return max(0, self.max_inflight - len(self._running))
+
+    # -- disaggregated KV handoff (ISSUE 19) --------------------------------
+    def extract_for_handoff(self, rid: int) -> dict:
+        """PREPARE half of the prefill->decode handoff: pull a freshly
+        prefilled RUNNING request out of the scheduler and publish its full
+        transfer state (token history + page table). The request record
+        stays, HANDED_OFF, with its pages still held — the PREFILL PIN the
+        two-phase protocol keeps until the adopting side commits — so the
+        audit and leak accounting see the pin as a live holder throughout.
+        The caller (the prefill replica) grants the lease over the
+        returned page table before anything else moves."""
+        req = self.requests[rid]
+        if req.state != RUNNING:
+            raise ValueError(
+                f"request {rid} is {req.state}; only RUNNING (prefilled) "
+                f"requests can hand off")
+        self._running.remove(req)
+        req.state = HANDED_OFF
+        self._count("handoff_extracts")
+        obs.event("serving.request",
+                  {"rid": rid, "phase": HANDED_OFF,
+                   "n_generated": req.n_generated, "pages": len(req.pages)})
+        return {"prompt_len": req.prompt_len,
+                "all_tokens": list(req.all_tokens),
+                "pages": list(req.pages),
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id, "sampling": req.sampling,
+                "priority": req.priority, "deadline_t": req.deadline_t}
+
+    def release_handoff(self, rid: int) -> None:
+        """Drop the prefill pin of a HANDED_OFF request (the adopting side
+        committed — its view now carries the transferred lease refcount —
+        or the handoff failed terminally and the router is cleaning up).
+        Idempotent: a second release, or one after this engine already
+        recovered, is a no-op."""
+        req = self.requests.pop(rid, None)
+        if req is None or req.state != HANDED_OFF:
+            return
+        if req.pages:
+            self.pool.release(req.pages)
+            req.pages = []
+
+    def adopt_request(self, handoff: dict) -> int:
+        """COMMIT half of the handoff: admit a request whose context KV
+        some OTHER engine already materialized into the shared pool — the
+        page table transfers, prefill is skipped entirely. The pages'
+        refcount arrives by lease transfer (the caller committed the lease
+        first), so this only records the pins in the owner ledger and
+        resumes decoding from wherever the prefill side stopped: with a
+        first token (the next decode step continues it) or at a full
+        prefix hit (the next decode step derives token one under COW —
+        the same regime a local full hit takes). The only admission rule
+        that re-runs is the RUNNING cap: an adopted request joins the
+        decode batch immediately, so it must fit max_inflight — the
+        adopting replica checks `decode_slots_free` and defers the commit
+        when full, and this guard backstops it (the caller returns the
+        transferred refcount to the pool on rejection, so nothing
+        leaks)."""
+        adopt = getattr(self.pool, "adopt_transferred", None)
+        if adopt is None:
+            raise RuntimeError(
+                "adopt_request needs a shared pool (OwnedPoolView): a "
+                "private pool cannot receive a lease-transferred refcount")
+        if len(self._running) >= self.max_inflight:
+            raise AdmissionRejected(
+                "adopt_no_decode_slot", 0.05,
+                {"running": len(self._running),
+                 "max_inflight": self.max_inflight})
+        toks = [int(t) for t in handoff["all_tokens"]]
+        pages = list(handoff["pages"])
+        prompt_len = int(handoff["prompt_len"])
+        if self.pool.pages_for(max(1, len(toks) - 1)) > len(pages):
+            raise ValueError(
+                f"adopted table has {len(pages)} pages for "
+                f"{len(toks) - 1} KV slots")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = GenRequest(rid, toks[:prompt_len], handoff["max_new_tokens"],
+                         handoff.get("eos_id"), handoff.get("sampling"),
+                         priority=int(handoff.get("priority", 1)))
+        req.all_tokens = toks
+        req.pages = pages
+        req.deadline_t = handoff.get("deadline_t")
+        req.state = RUNNING
+        req.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        adopt(pages)
+        self.requests[rid] = req
+        self._running.append(req)
+        self._count("adopts")
+        obs.event("serving.request",
+                  {"rid": rid, "phase": "adopted",
+                   "n_generated": req.n_generated, "pages": len(pages)})
+        return rid
+
     def result(self, rid: int) -> list[int]:
         return list(self.requests[rid].out_tokens)
 
@@ -576,13 +717,17 @@ class ServingEngine:
 
     def leaked_pages(self) -> int:
         """Pages in use that NO live request and NO prefix-cache entry can
-        account for — must be zero at every quiescent point."""
+        account for — must be zero at every quiescent point. Over a shared
+        pool the base is this OWNER's pages (the OwnedPoolView ledger), not
+        the global pool: peers' pages are theirs to account for."""
         mapped: set[int] = set()
         for r in self.requests.values():
             mapped.update(r.pages)
         if self.prefix_cache is not None:
             mapped.update(n.page for n in self.prefix_cache._nodes.values())
-        return self.pool.pages_in_use - len(mapped)
+        in_use = getattr(self.pool, "owned_pages_in_use",
+                         self.pool.pages_in_use)
+        return in_use - len(mapped)
 
     def flush_prefix_cache(self) -> int:
         """Evict every prefix-cache entry no live request still maps (frees
@@ -646,10 +791,13 @@ class ServingEngine:
                 return True
         self._update_ladder()
         admitted = self._admit()
-        if self._running:
+        if self._running and not self.prefill_only:
             with obs.span("serving.decode"):
                 decoded = self._decode_once()
         else:
+            # prefill-only engines stop at the prompt boundary: freshly
+            # prefilled rows sit RUNNING until extract_for_handoff moves
+            # them to a decode engine
             decoded = False
         # a request that crossed its TTL inside the prefill/decode above is
         # caught here — "mid-step" expiry still releases pages this step
@@ -662,7 +810,10 @@ class ServingEngine:
                     f"request needs {need} pages but the pool only has "
                     f"{self.pool.num_pages} (FLAGS_serving_pool_pages / "
                     f"FLAGS_serving_page_size)")
-            if not self._running:
+            if not self._running and not self._shared_pool:
+                # over a SHARED pool this engine being starved is not
+                # fatal: peers (or the lease reaper) free pages it never
+                # could — keep waiting instead of declaring deadlock
                 raise RuntimeError(
                     "admission stuck: no running requests to free pages, "
                     f"yet {len(self._waiting)} waiting (free "
@@ -928,6 +1079,13 @@ class ServingEngine:
         for req in self._waiting:
             req.pages = []  # admission pins die with the pool rebuild
             req.cached_len = 0
+        for req in self.requests.values():
+            if req.state == HANDED_OFF:
+                # the rebuild forfeits the prefill pin with everything
+                # else; clear the table so a late release_handoff cannot
+                # double-release (the LEASE still keeps the pages alive
+                # for the adopting side)
+                req.pages = []
         self._waiting[:0] = survivors
         if self.prefix_cache is not None:
             self.prefix_cache.clear()

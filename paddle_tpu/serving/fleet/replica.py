@@ -34,6 +34,22 @@ Outbox event shapes (consumed by FleetRouter.poll):
     ("done",    fid, terminal_engine_state)     request left the engine
     ("reject",  fid, retry_after_s)             engine admission refused
     ("handoff", fid)                            draining replica gave it up
+    ("prepared", fid, lease_id)                 prefill published a lease
+    ("adopted",  fid, lease_id)                 decode committed + adopted
+    ("commit_failed", fid, lease_id, why)       commit bounced; replay me
+
+Disaggregation (ISSUE 19): a `role="prefill"` replica never decodes —
+after each step it extracts every freshly prefilled RUNNING request,
+publishes it under a TTL'd lease (`HandoffManager.prepare`) and emits
+"prepared"; the request sits HANDED_OFF (pages pinned) in `_pinned` until
+the router's {"release": fid} job confirms the adopting side committed.
+A decode-capable replica receives {"commit": lease_id, "fid": fid} jobs:
+commit transfers the lease refcount, `engine.adopt_request` resumes the
+decode mid-request, and every token streams from here (`_sent` starts at
+0, so the prefill-produced first token is delivered by the ADOPTER — the
+prefill side streams nothing for handed-off requests). The
+`disagg_prefill_kill` fault site SIGKILLs a prefill replica exactly like
+`fleet_replica_kill` does a generic one.
 """
 from __future__ import annotations
 
@@ -63,10 +79,13 @@ class EngineReplica:
     the DRAINING->RETIRED transition, which the pump takes itself (only it
     knows when the engine is empty)."""
 
-    def __init__(self, rid: int, engine, monitor, name: str | None = None):
+    def __init__(self, rid: int, engine, monitor, name: str | None = None,
+                 role: str = "mixed", handoff=None):
         self.rid = int(rid)
         self.engine = engine
         self.monitor = monitor
+        self.role = str(role)  # "mixed" | "prefill" | "decode"
+        self.handoff = handoff  # shared HandoffManager (disagg fleets only)
         self.name = name or f"replica{rid}"
         self.state = HEALTHY
         self._inbox: deque = deque()
@@ -85,6 +104,9 @@ class EngineReplica:
         # pump-side maps: engine rid -> (fid, tokens already streamed out)
         self._fid_of: dict[int, int] = {}
         self._sent: dict[int, int] = {}
+        # prefill-side: fid -> engine rid of a HANDED_OFF request whose
+        # prefill pin awaits the router's post-commit {"release": fid}
+        self._pinned: dict[int, int] = {}
         monitor.register(self.name)
 
     # -- router-side API (thread-safe) --------------------------------------
@@ -162,6 +184,17 @@ class EngineReplica:
             self.crash = e
             self.sigkill()
             return False
+        if self.role == "prefill":
+            try:
+                # targeted SIGKILL of the prefill stage: any lease this
+                # replica already published survives in the SHARED pool and
+                # still commits; anything pre-PREPARE replays on a
+                # surviving prefill replica
+                fault_point("disagg_prefill_kill")
+            except InjectedFault as e:
+                self.crash = e
+                self.sigkill()
+                return False
         try:
             fault_point("fleet_replica_hang")
         except InjectedFault:
@@ -193,6 +226,12 @@ class EngineReplica:
         if self.engine.has_work():
             self.engine.step()
             progressed = True
+        if self.role == "prefill" and self.handoff is not None:
+            # BEFORE streaming: extraction removes the request from
+            # `_fid_of`, so the prefill-produced first token never streams
+            # from here — the adopter delivers it (exactly-once by
+            # construction, not by dedup)
+            self._extract_prepared()
         self._stream_deltas()
         if (self.state == DRAINING and not self.engine.has_work()
                 and not self._inbox):
@@ -205,12 +244,34 @@ class EngineReplica:
         with self._lock:
             jobs, self._inbox = list(self._inbox), deque()
         moved = False
+        deferred: list[dict] = []
         for job in jobs:
             if "abort" in job:
                 fid = job["abort"]
                 erids = [e for e, f in self._fid_of.items() if f == fid]
                 for erid in erids:
                     self.engine.abort(erid)
+                moved = True
+                continue
+            if "release" in job:
+                # post-commit confirmation: the adopter's ledger carries
+                # the pages now, drop the prefill pin (idempotent)
+                erid = self._pinned.pop(job["release"], None)
+                if erid is not None:
+                    self.engine.release_handoff(erid)
+                moved = True
+                continue
+            if "commit" in job:
+                # backpressure: an adopted request enters RUNNING directly,
+                # so the commit waits for a decode slot rather than consume
+                # the lease into an overfull batch. The lease keeps aging —
+                # if this replica stays saturated past the TTL, the reaper
+                # replays the request elsewhere.
+                if self.state != DRAINING \
+                        and not self.engine.decode_slots_free:
+                    deferred.append(job)
+                    continue
+                self._commit_job(job["commit"], job["fid"])
                 moved = True
                 continue
             fid = job["fid"]
@@ -231,6 +292,9 @@ class EngineReplica:
             self._fid_of[erid] = fid
             self._sent[erid] = 0
             moved = True
+        if deferred:  # retry next pump, ahead of newer jobs
+            with self._lock:
+                self._inbox.extendleft(reversed(deferred))
         return moved
 
     def _handoff_waiting(self) -> None:
@@ -247,6 +311,51 @@ class EngineReplica:
                 self._fid_of.pop(erid, None)
                 self._sent.pop(erid, None)
                 self._emit("handoff", fid)
+
+    def _extract_prepared(self) -> None:
+        """PREPARE: every request this prefill engine finished prefilling
+        (state RUNNING — requests that went terminal AT prefill stream
+        normally from here) leaves the scheduler HANDED_OFF and its
+        transfer state is published under a TTL'd lease. From this emit on
+        the request's fate is the lease's: commit adopts it elsewhere,
+        reap replays it, and our pin waits for the router's release."""
+        for erid, fid in list(self._fid_of.items()):
+            req = self.engine.requests.get(erid)
+            if req is None or req.state != "running":
+                continue
+            payload = self.engine.extract_for_handoff(erid)
+            lid = self.handoff.prepare(fid, payload)
+            self._pinned[fid] = erid
+            self._fid_of.pop(erid, None)
+            self._sent.pop(erid, None)
+            self._emit("prepared", fid, lid)
+
+    def _commit_job(self, lid: str, fid: int) -> None:
+        """COMMIT: adopt one leased request into this engine. Every
+        failure mode answers with "commit_failed" — silence would strand
+        the request until the lease reaper noticed — and the commit/adopt
+        pair never half-applies: a commit that throws left the pin with
+        the lease; an adopt that throws hands the transferred refcount
+        straight back to the shared pool."""
+        from .handoff import HandoffError
+
+        if self.state == DRAINING:
+            self._emit("commit_failed", fid, lid, "draining")
+            return
+        try:
+            lease = self.handoff.commit(lid)
+        except HandoffError as e:
+            self._emit("commit_failed", fid, lid, repr(e))
+            return
+        try:
+            erid = self.engine.adopt_request(lease.payload)
+        except Exception as e:  # noqa: BLE001 — refcount must not strand
+            self.handoff.pool.release(lease.pages)
+            self._emit("commit_failed", fid, lid, repr(e))
+            return
+        self._fid_of[erid] = fid
+        self._sent[erid] = 0
+        self._emit("adopted", fid, lid)
 
     def _stream_deltas(self) -> None:
         for erid, fid in list(self._fid_of.items()):

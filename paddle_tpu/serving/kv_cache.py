@@ -26,6 +26,22 @@ Admission control is the caller's job (engine.py): `can_allocate` is the
 backpressure predicate — when the free list runs dry, new requests queue
 instead of OOMing the pool, and mid-decode growth preempts rather than
 corrupts.
+
+Disaggregated serving (ISSUE 19) adds two more pieces of pure bookkeeping:
+
+  * LEASES — a page can be pinned by a named lease (`lease_grant`), the
+    in-transit holder class of the prefill->decode KV handoff: the pin
+    keeps the pages alive while neither engine's request table maps them,
+    `lease_transfer` hands the refcount to the adopting side without a
+    release/share round-trip, and `check_consistency` models leases as
+    first-class holders so a mid-handoff audit neither false-flags nor
+    misses them;
+  * `OwnedPoolView` — a per-engine facade over ONE shared pool that
+    mirrors the allocator API while keeping the owner's own holder
+    ledger. The ledger belongs to the pool layer (what a disaggregated
+    memory node tracks per client), so when a replica dies the router
+    reclaims its pins through `forfeit()` without ever touching the dead
+    engine.
 """
 from __future__ import annotations
 
@@ -33,7 +49,7 @@ import heapq
 
 import jax.numpy as jnp
 
-__all__ = ["PagedKVPool", "PrefixCache", "pool_var_names",
+__all__ = ["PagedKVPool", "PrefixCache", "OwnedPoolView", "pool_var_names",
            "create_device_pools", "declare_pool_vars"]
 
 
@@ -89,6 +105,8 @@ class PagedKVPool:
         # the pool's hot working set small
         self._free: list[int] = list(range(self.num_pages - 1, -1, -1))
         self._refs: list[int] = [0] * self.num_pages
+        # in-transit holder class (ISSUE 19): lease id -> pinned page table
+        self._leases: dict[str, list[int]] = {}
 
     # -- sizing ---------------------------------------------------------------
     def pages_for(self, n_tokens: int) -> int:
@@ -165,6 +183,40 @@ class PagedKVPool:
         """Single-holder spelling of `release` (the PR 7 API)."""
         self.release(pages)
 
+    # -- leases: the in-transit holder class (ISSUE 19) -----------------------
+    def lease_grant(self, lease_id: str, pages: list[int]) -> None:
+        """Pin `pages` under a named lease (one extra holder per page, via
+        `share` — only live pages can be leased). The lease is the handoff
+        protocol's safety net: it keeps the pages alive even if BOTH the
+        granting and the adopting engine die mid-transfer."""
+        if lease_id in self._leases:
+            raise ValueError(f"lease {lease_id!r} already granted")
+        self.share(pages)
+        self._leases[lease_id] = list(pages)
+
+    def lease_transfer(self, lease_id: str) -> list[int]:
+        """Commit a lease: drop the lease record WITHOUT releasing the
+        refcount — ownership of the pin moves to the adopting holder (its
+        page table / owner ledger), so the handoff is a pure metadata move
+        with no release/share window where the pages could be freed."""
+        if lease_id not in self._leases:
+            raise KeyError(f"lease {lease_id!r} not held")
+        return self._leases.pop(lease_id)
+
+    def lease_release(self, lease_id: str) -> int:
+        """Reap a lease: drop the record AND its pin (the orphaned-prepare
+        path — commit never arrived). Returns pages actually freed."""
+        if lease_id not in self._leases:
+            raise KeyError(f"lease {lease_id!r} not held")
+        return self.release(self._leases.pop(lease_id))
+
+    def lease_pages(self, lease_id: str) -> list[int]:
+        return list(self._leases[lease_id])
+
+    @property
+    def leased_page_count(self) -> int:
+        return sum(len(p) for p in self._leases.values())
+
     # -- invariant audit (ISSUE 14) -------------------------------------------
     def check_consistency(self,
                           holders: "dict[int, int] | None" = None
@@ -179,11 +231,28 @@ class PagedKVPool:
           * with `holders` (page id -> how many live page-table/cache
             entries map it, built by the engine), each page's refcount
             equals its holder count — a phantom holder pins HBM forever, a
-            missing one frees a page someone still reads.
+            missing one frees a page someone still reads. Leased pages
+            (ISSUE 19) count as one holder per lease pin, so a page that is
+            mid-handoff — pinned by a lease while no request table maps
+            it — audits clean, and a forged lease record (a pin the
+            refcount never backed) audits dirty.
 
         Pure read; the recovery pass runs it before and after a rebuild."""
         problems: list[str] = []
         free_set = set(self._free)
+        lease_holds: dict[int, int] = {}
+        for lid, pages in self._leases.items():
+            for p in pages:
+                if not (0 <= p < self.num_pages):
+                    problems.append(f"lease {lid!r} pins page {p} outside "
+                                    f"the pool [0, {self.num_pages})")
+                    continue
+                lease_holds[p] = lease_holds.get(p, 0) + 1
+        for p, c in sorted(lease_holds.items()):
+            if self._refs[p] < c:
+                problems.append(
+                    f"page {p} carries {c} lease pins but refcount "
+                    f"{self._refs[p]} (forged or duplicate lease)")
         if len(free_set) != len(self._free):
             dupes = sorted({p for p in self._free if self._free.count(p) > 1})
             problems.append(f"free list holds duplicate entries {dupes[:8]}")
@@ -203,10 +272,12 @@ class PagedKVPool:
                                 f"from the free list")
         if holders is not None:
             for p in range(self.num_pages):
-                h = holders.get(p, 0)
+                h = holders.get(p, 0) + lease_holds.get(p, 0)
                 if self._refs[p] > 0 and self._refs[p] != h:
+                    leased = lease_holds.get(p, 0)
+                    suffix = f" (of which {leased} leased)" if leased else ""
                     problems.append(f"page {p} refcount {self._refs[p]} != "
-                                    f"{h} live holders")
+                                    f"{h} live holders{suffix}")
                 elif self._refs[p] == 0 and h:
                     problems.append(f"page {p} is free but {h} live holders "
                                     f"still map it")
@@ -220,6 +291,161 @@ class PagedKVPool:
         them."""
         self._free = list(range(self.num_pages - 1, -1, -1))
         self._refs = [0] * self.num_pages
+        self._leases = {}
+
+
+class OwnedPoolView:
+    """Per-engine facade over ONE shared `PagedKVPool` (disaggregated
+    serving, ISSUE 19).
+
+    Mirrors the allocator surface the engine and its PrefixCache use
+    (allocate/share/release/free/refcount/can_allocate/pages_for), while
+    keeping an OWNER LEDGER: how many holders this owner has on each page.
+    The ledger buys three things a raw shared pool cannot give:
+
+      * a per-engine audit (`check_consistency`) scoped to the engine's
+        own holdings — another engine's pages are not "phantom holders";
+      * per-engine leak accounting (`owned_pages_in_use`) while occupancy
+        and backpressure still read the honest GLOBAL pool pressure;
+      * dead-replica reclamation (`forfeit`) — the ledger is pool-layer
+        state (what a disaggregated memory node tracks per client), so
+        the router can return a SIGKILLed replica's pins to the free list
+        without ever touching the dead engine.
+
+    `adopt_transferred` records pins whose refcount arrived by
+    `PagedKVPool.lease_transfer` — the commit half of the KV handoff.
+    Not thread-safe, like the pool underneath: disaggregated fleets run
+    the inline pump (one scheduler thread owns the shared pool).
+    """
+
+    def __init__(self, pool: PagedKVPool, owner: str):
+        self.pool = pool
+        self.owner = str(owner)
+        self._held: dict[int, int] = {}
+
+    # -- delegated sizing/pressure (GLOBAL: backpressure must be honest) ----
+    @property
+    def num_pages(self) -> int:
+        return self.pool.num_pages
+
+    @property
+    def page_size(self) -> int:
+        return self.pool.page_size
+
+    @property
+    def free_count(self) -> int:
+        return self.pool.free_count
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.pool.pages_in_use
+
+    @property
+    def owned_pages_in_use(self) -> int:
+        """Distinct pages this owner holds (the per-engine leak base)."""
+        return len(self._held)
+
+    # the serving_pool_corrupt chaos payload vandalizes these directly
+    @property
+    def _refs(self):
+        return self.pool._refs
+
+    @property
+    def _free(self):
+        return self.pool._free
+
+    def occupancy(self) -> float:
+        return self.pool.occupancy()
+
+    def pages_for(self, n_tokens: int) -> int:
+        return self.pool.pages_for(n_tokens)
+
+    def refcount(self, page: int) -> int:
+        return self.pool.refcount(page)
+
+    def can_allocate(self, n: int) -> bool:
+        return self.pool.can_allocate(n)
+
+    # -- ledgered mutations --------------------------------------------------
+    def _note(self, pages, d: int) -> None:
+        for p in pages:
+            c = self._held.get(p, 0) + d
+            if c > 0:
+                self._held[p] = c
+            else:
+                self._held.pop(p, None)
+
+    def allocate(self, n: int) -> list[int] | None:
+        got = self.pool.allocate(n)
+        if got is not None:
+            self._note(got, +1)
+        return got
+
+    def share(self, pages: list[int]) -> None:
+        self.pool.share(pages)
+        self._note(pages, +1)
+
+    def release(self, pages: list[int]) -> int:
+        freed = self.pool.release(pages)
+        self._note(pages, -1)
+        return freed
+
+    def free(self, pages: list[int]) -> None:
+        self.release(pages)
+
+    def adopt_transferred(self, pages: list[int]) -> None:
+        """Record pins whose refcount was moved here by `lease_transfer`
+        (handoff commit): ledger only — the pool refcount already counts
+        them, bumping it again would pin the pages forever."""
+        for p in pages:
+            if self.pool.refcount(p) <= 0:
+                raise ValueError(f"adopting free page {p} (refcount 0)")
+        self._note(pages, +1)
+
+    def forfeit(self) -> int:
+        """Return EVERY pin this owner holds to the shared pool (the owner
+        died — its requests, admission pins, and prefix-cache refs will
+        never release themselves). Lease pins are the HandoffManager's,
+        not the owner's, so in-transit pages survive the forfeit. Returns
+        pages actually freed."""
+        freed = 0
+        for p, c in list(self._held.items()):
+            freed += self.pool.release([p] * c)
+        self._held.clear()
+        return freed
+
+    def reset(self) -> None:
+        """The engine recovery pass's pool rebuild, owner-scoped: drop this
+        owner's pins only — resetting the SHARED pool underneath would
+        vandalize every other engine's live state."""
+        self.forfeit()
+
+    # -- owner-scoped audit --------------------------------------------------
+    def check_consistency(self,
+                          holders: "dict[int, int] | None" = None
+                          ) -> list[str]:
+        """Global partition + lease invariants from the shared pool, plus
+        the owner-scoped holder check: `holders` (built by THIS engine)
+        must equal the owner ledger exactly, and the ledger can never
+        exceed the global refcount."""
+        problems = list(self.pool.check_consistency(None))
+        if holders is not None:
+            for p, c in sorted(self._held.items()):
+                h = holders.get(p, 0)
+                if h != c:
+                    problems.append(
+                        f"[{self.owner}] page {p}: owner ledger holds {c} "
+                        f"but {h} live holders map it")
+                if self.pool.refcount(p) < c:
+                    problems.append(
+                        f"[{self.owner}] page {p}: owner ledger holds {c} "
+                        f"exceeding pool refcount {self.pool.refcount(p)}")
+            for p, h in sorted(holders.items()):
+                if h and p not in self._held:
+                    problems.append(
+                        f"[{self.owner}] page {p} mapped by {h} live "
+                        f"holders but absent from the owner ledger")
+        return problems
 
 
 class _PrefixNode:

@@ -17,6 +17,21 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", jax.devices()
 
+# Persistent XLA compile cache: the serving/fleet tests build many engines
+# whose programs lower to identical executables, but the executor's
+# in-memory cache is per-Program so every engine recompiles from scratch.
+# Content-addressed disk caching dedups those compiles within a run and
+# across runs (the engine-heavy files drop ~2-3x in wall time). Keep the
+# default write thresholds: forcing min-compile-time/min-entry-size to 0
+# makes the cache persist every tiny executable, including ones built on
+# the checkpoint writer's async thread, and that segfaults this
+# jaxlib/tensorstore combination. Honor a caller-provided dir.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    _cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+
 import numpy as np
 import pytest
 

@@ -51,3 +51,24 @@ def test_chaos_fleet_drill_kill_hang_slowbeat_and_drain():
     assert out["stats"]["deaths"] >= 1
     assert out["stats"]["replay_divergence"] == 0
     assert out["retired"] == 1
+
+
+@pytest.mark.chaos
+def test_chaos_disagg_drill_kill_drop_expiry_and_decode_kill():
+    """ISSUE 19 disaggregation scenarios, sized for tier-1: a prefill
+    SIGKILL mid-wave, a dropped handoff (the lease reaper must reclaim and
+    replay it), the lease-expiry race at commit, then a decode SIGKILL
+    holding adopted pages — zero lost requests, outputs byte-identical to
+    the fault-free single-engine oracle, zero leaked pages, a clean
+    shared-pool audit and no lease left PREPARED (all asserted inside the
+    drill)."""
+    out = chaos.run_disagg_drill(cycles=3, n_req=3, seed=1, verbose=False)
+    assert len(out["cycles"]) == 3
+    sites = {c["site"] for c in out["cycles"]}
+    assert sites == {"disagg_prefill_kill", "disagg_handoff_drop",
+                     "disagg_lease_expire_race"}
+    assert any(c["fired"] for c in out["cycles"]), "no fault ever fired"
+    assert out["deaths"] >= 2  # a prefill kill + the decode-kill finale
+    assert out["handoff"]["granted"] >= out["handoff"]["committed"]
+    assert out["handoff"]["reaped"] >= 1
+    assert out["stats"]["replay_divergence"] == 0

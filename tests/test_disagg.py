@@ -1,0 +1,397 @@
+"""Disaggregated prefill/decode serving (ISSUE 19): the transactional KV
+handoff state machine (prepare -> commit happy path, reaper reclaiming an
+expired lease under an injected clock, double-commit and commit-after-reap
+rejected, abandon/supersede), leases as a first-class holder class in the
+pool audit (a forged lease audits DIRTY), role-aware placement (affinity
+hashes over the decode universe only; a prefill replica is never a decode
+home), and end-to-end byte-exactness of the role-split fleet against the
+single-engine oracle — fault-free, under shared-prefix + speculative-decode
+arms, and through every disagg fault site (prefill SIGKILL pre-commit,
+dropped handoff reaped + replayed, the lease-expiry race at commit, and a
+decode SIGKILL holding adopted pages).
+
+Tier-1 keeps the unit tests plus one fault-free exactness pass per fleet
+shape and the in-fleet lease-expiry race; the remaining per-site fault
+walks are @slow because tests/test_chaos.py's disagg drill already proves
+every fault arm byte-exact inside the tier-1 budget."""
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience.faults import fault_scope
+from paddle_tpu.serving import (FleetRouter, PagedKVPool, ServingEngine,
+                                decoder_tiny, disagg_fleet_factory)
+from paddle_tpu.serving.fleet.handoff import (COMMITTED, PREPARED, REAPED,
+                                              HandoffError, HandoffManager,
+                                              LeaseExpired)
+
+
+def _prompts(n: int, seed: int = 7) -> list[list[int]]:
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 97, size=4 + i % 3).tolist() for i in range(n)]
+
+
+_ORACLE_ENGINE = None
+
+
+def _oracle(prompts, max_new: int) -> list[list[int]]:
+    """Greedy single-engine reference outputs. Greedy decode is a pure
+    function of (weights, prompt), so one module-wide engine serves every
+    test's oracle wave — building a fresh ServingEngine per test is the
+    dominant cost of this file."""
+    global _ORACLE_ENGINE
+    if _ORACLE_ENGINE is None:
+        _ORACLE_ENGINE = ServingEngine(decoder_tiny(), page_size=4,
+                                       pool_pages=64, max_inflight=4,
+                                       draft_k=0, seed=0)
+    eng = _ORACLE_ENGINE
+    rids = [eng.submit(p, max_new) for p in prompts]
+    eng.run_until_drained()
+    out = [eng.result(r) for r in rids]
+    eng.prune_finished()
+    assert eng.leaked_pages() == 0
+    return out
+
+
+def _fleet(roles, heartbeat_s: float = 30.0, lease_ttl_s=None,
+           affinity: bool = False, **factory_kw) -> FleetRouter:
+    factory_kw.setdefault("page_size", 4)
+    factory_kw.setdefault("pool_pages", 64)
+    factory_kw.setdefault("max_inflight", 4)
+    factory_kw.setdefault("draft_k", 0)
+    factory_kw.setdefault("seed", 0)
+    factory = disagg_fleet_factory(decoder_tiny(), **factory_kw)
+    return FleetRouter(factory, len(roles), roles=list(roles),
+                       heartbeat_s=heartbeat_s, affinity=affinity,
+                       lease_ttl_s=lease_ttl_s)
+
+
+def _serve(fr: FleetRouter, prompts, max_new: int, plan=None):
+    fids = [fr.submit(p, max_new) for p in prompts]
+    if plan is not None:
+        with fault_scope(plan):
+            fr.run_until_idle()
+    else:
+        fr.run_until_idle()
+    assert all(fr.state(f) == "finished" for f in fids), \
+        {f: fr.state(f) for f in fids}
+    return [fr.result(f) for f in fids]
+
+
+def _assert_clean(fr: FleetRouter) -> None:
+    """The zero-leak postcondition every disagg test ends on: no lease
+    left PREPARED, a clean shared-pool audit, zero leaked pages on every
+    surviving engine, and zero replay divergence."""
+    assert fr.handoff.active() == 0
+    assert fr.handoff.pool.check_consistency(None) == []
+    for rep in fr.replicas:
+        if rep.alive:
+            assert rep.engine.leaked_pages() == 0, f"replica {rep.rid}"
+    assert fr.stats["replay_divergence"] == 0
+
+
+class _Clock:
+    """Injectable monotonic clock for deterministic reaper tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- leases as a holder class in the pool audit (satellite 1) ----------------
+
+def test_lease_is_first_class_audit_holder_and_forged_lease_is_dirty():
+    pool = PagedKVPool(8, 4)
+    pages = pool.allocate(2)
+    holders = {p: 1 for p in pages}
+    assert pool.check_consistency(holders) == []
+
+    # grant: one extra pin per page; the lease pin counts as a holder
+    pool.lease_grant("l0", pages)
+    assert pool.check_consistency(holders) == []
+    assert pool.leased_page_count == 2
+    with pytest.raises(ValueError):
+        pool.lease_grant("l0", pages)  # ids are single-use
+
+    # mid-handoff: the granting table dropped its pin, NO request maps the
+    # pages, only the lease keeps them alive -> still a clean audit
+    pool.release(pages)
+    assert pool.check_consistency({}) == []
+    assert all(p not in pool._free for p in pages)
+
+    # transfer: the record drops, the refcount does NOT — the pin now
+    # belongs to the adopter's table (no release/share window)
+    moved = pool.lease_transfer("l0")
+    assert sorted(moved) == sorted(pages)
+    assert pool.leased_page_count == 0
+    assert pool.check_consistency({p: 1 for p in pages}) == []
+    assert pool.release(pages) == 2  # adopter done -> pages actually free
+    assert pool.check_consistency({}) == []
+
+    # a forged lease record — a pin the refcount never backed — is DIRTY
+    held = pool.allocate(1)
+    pool._leases["forged"] = [held[0], held[0]]
+    problems = pool.check_consistency({held[0]: 1})
+    assert any("forged or duplicate lease" in p for p in problems)
+    del pool._leases["forged"]
+    pool.release(held)
+
+
+def test_lease_release_reclaims_the_orphaned_pin():
+    pool = PagedKVPool(8, 4)
+    pages = pool.allocate(3)
+    pool.lease_grant("l0", pages)
+    pool.release(pages)          # granting side is gone
+    assert pool.lease_release("l0") == 3  # reap frees for real
+    assert pool.check_consistency({}) == []
+    assert len(pool._free) == 8
+    with pytest.raises(KeyError):
+        pool.lease_release("l0")
+
+
+# -- the handoff state machine (satellite 4, unit level) ---------------------
+
+def _manager(pool, ttl_s=5.0):
+    clk = _Clock()
+    return HandoffManager(pool, ttl_s=ttl_s, clock=clk), clk
+
+
+def test_handoff_prepare_then_commit_happy_path():
+    pool = PagedKVPool(8, 4)
+    pages = pool.allocate(2)
+    hm, clk = _manager(pool)
+    lid = hm.prepare(7, {"pages": pages})
+    assert hm.active() == 1
+    assert hm.is_current(hm.leases[lid])
+    assert pool.leased_page_count == 2
+
+    clk.t = 1.0  # well inside the TTL
+    lease = hm.commit(lid)
+    assert lease.state == COMMITTED
+    assert lease.fid == 7 and lease.pages == pages
+    assert hm.active() == 0
+    assert pool.leased_page_count == 0       # pin moved, not released
+    assert pool.check_consistency({p: 2 for p in pages}) == []
+    assert hm.stats["granted"] == 1 and hm.stats["committed"] == 1
+    assert hm.stats["reaped"] == 0 and hm.stats["commit_failed"] == 0
+
+
+def test_handoff_reaper_reclaims_expired_lease():
+    pool = PagedKVPool(8, 4)
+    pages = pool.allocate(2)
+    hm, clk = _manager(pool, ttl_s=5.0)
+    lid = hm.prepare(1, {"pages": pages})
+    pool.release(pages)  # prefill side already dropped its pin
+
+    clk.t = 4.0
+    assert hm.reap_expired() == []           # not yet
+    clk.t = 5.5
+    reaped = hm.reap_expired()
+    assert [l.lease_id for l in reaped] == [lid]
+    assert reaped[0].state == REAPED
+    assert len(pool._free) == 8              # the orphaned pin came back
+    assert hm.reap_expired() == []           # reaping is exactly-once
+    assert hm.stats["reaped"] == 1
+
+
+def test_handoff_double_commit_rejected():
+    pool = PagedKVPool(8, 4)
+    pages = pool.allocate(1)
+    hm, _clk = _manager(pool)
+    lid = hm.prepare(1, {"pages": pages})
+    hm.commit(lid)
+    with pytest.raises(HandoffError, match="double commit"):
+        hm.commit(lid)
+    with pytest.raises(HandoffError, match="unknown lease"):
+        hm.commit("lease-404")
+    assert hm.stats["commit_failed"] == 2
+    assert pool.check_consistency({pages[0]: 2}) == []  # pin undisturbed
+
+
+def test_handoff_commit_after_reap_and_expiry_race_reclaim_exactly_once():
+    pool = PagedKVPool(8, 4)
+    hm, clk = _manager(pool, ttl_s=5.0)
+
+    # commit-after-reap: the reaper won long ago; the commit must lose
+    a = pool.allocate(1)
+    lid = hm.prepare(1, {"pages": a})
+    pool.release(a)
+    clk.t = 6.0
+    hm.reap_expired()
+    with pytest.raises(LeaseExpired, match="after reap"):
+        hm.commit(lid)
+    assert len(pool._free) == 8  # reclaimed once, by the reap, not twice
+
+    # expiry discovered AT commit: the commit itself reaps, then rejects
+    b = pool.allocate(1)
+    lid2 = hm.prepare(2, {"pages": b})
+    pool.release(b)
+    clk.t = 20.0
+    with pytest.raises(LeaseExpired, match="expired before commit"):
+        hm.commit(lid2)
+    assert hm.leases[lid2].state == REAPED
+    assert len(pool._free) == 8
+    assert hm.stats["expired_at_commit"] == 1
+    assert hm.stats["reaped"] == 2
+
+
+def test_handoff_abandon_and_supersede():
+    pool = PagedKVPool(8, 4)
+    hm, _clk = _manager(pool)
+    pages = pool.allocate(1)
+    lid = hm.prepare(1, {"pages": pages})
+    hm.supersede(1)  # the router replayed fid 1 from scratch
+    assert not hm.is_current(hm.leases[lid])
+    assert hm.abandon(lid)          # reap NOW, TTL notwithstanding
+    assert not hm.abandon(lid)      # idempotent: only PREPARED reaps
+    assert hm.leases[lid].state == REAPED
+    assert pool.leased_page_count == 0
+
+    lid2 = hm.prepare(2, {"pages": pages})
+    hm.commit(lid2)
+    assert not hm.abandon(lid2)     # committed leases are out of reach
+
+
+# -- role-aware placement (satellite 2) --------------------------------------
+
+def test_roles_are_validated_at_construction():
+    fac = disagg_fleet_factory(decoder_tiny(), page_size=4, pool_pages=64,
+                               max_inflight=2, draft_k=0, seed=0)
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter(fac, 2, roles=["prefill", "prefill"])
+    with pytest.raises(ValueError, match="roles"):
+        FleetRouter(fac, 3, roles=["prefill", "decode"])
+    with pytest.raises(ValueError, match="inline"):
+        FleetRouter(fac, 2, roles=["prefill", "decode"], pump="threads")
+    # a role-split fleet REQUIRES the shared pool: plain per-engine
+    # factories cannot hand off tables
+    def plain(role="mixed"):
+        return ServingEngine(decoder_tiny(), page_size=4, pool_pages=64,
+                             max_inflight=2, draft_k=0, seed=0)
+    with pytest.raises(ValueError, match="disagg_fleet_factory"):
+        FleetRouter(plain, 2, roles=["prefill", "decode"])
+
+
+# -- end-to-end byte-exactness (tentpole + satellites 2 + 4) -----------------
+#
+# One 1-prefill + 2-decode fleet carries three waves: the fault-free
+# greedy-exactness pass, the resubmission/affinity pass, and the
+# lease-expiry race at commit. Sharing the fleet keeps tier-1 wall time
+# down without dropping an assertion.
+
+def test_disagg_affinity_greedy_exactness_and_lease_expiry_race():
+    prompts = _prompts(5)
+    want = _oracle(prompts, 7)
+    with _fleet(["prefill", "decode", "decode"], affinity=True) as fr:
+        # affinity hashes over the decode universe only
+        decode_rids = {r.rid for r in fr.replicas if r.role == "decode"}
+        for p in _prompts(12, seed=3):
+            assert fr._affinity_rid(p) in decode_rids
+
+        # wave 1+2: fault-free exactness, same home on resubmission
+        got = _serve(fr, prompts, 7)
+        again = _serve(fr, prompts, 7)
+        assert fr.handoff.stats["committed"] >= 1
+        assert fr.stats["handoff.replays"] == 0
+        assert fr.stats["affinity_hits"] == 10
+        assert fr.stats["prefill_dispatches"] == 10
+
+        # the prefill replica only ever prefills + extracts; every decode
+        # token was produced by an adopter
+        pre = next(r for r in fr.replicas if r.role == "prefill")
+        assert pre.engine.stats["handoff_extracts"] >= 1
+        assert pre.engine.stats["adopts"] == 0
+        assert sum(r.engine.stats["adopts"] for r in fr.replicas
+                   if r.role == "decode") >= 1
+        _assert_clean(fr)
+
+        # wave 3: the lease expires UNDER the commit; the reaper inside
+        # commit reclaims once and the router replays byte-exact
+        fr.reset_stats()
+        race = _prompts(3)
+        want_race = _oracle(race, 5)
+        got_race = _serve(fr, race, 5, plan="disagg_lease_expire_race:1")
+        assert fr.handoff.stats["expired_at_commit"] >= 1
+        assert fr.stats["handoff.replays"] >= 1
+        _assert_clean(fr)
+    assert got == want and again == want
+    assert got_race == want_race
+
+
+def test_disagg_shared_prefix_and_spec_decode_stay_exact():
+    # shared system prompt -> the PREFILL stage absorbs the prefix reuse;
+    # draft_k>0 on the decode engines must stay exact under greedy
+    base = [5, 6, 7, 8, 9, 10, 11, 12]
+    prompts = [base + [t] for t in (20, 30, 40, 50)]
+    want = _oracle(prompts, 6)
+    with _fleet(["prefill", "decode", "decode"], draft_k=2) as fr:
+        got = _serve(fr, prompts, 6)
+        _assert_clean(fr)
+    assert got == want
+
+
+@pytest.mark.slow
+def test_disagg_prefill_kill_pre_commit_replays_exactly():
+    prompts = _prompts(4)
+    want = _oracle(prompts, 6)
+    with _fleet(["prefill", "prefill", "decode", "decode"],
+                heartbeat_s=0.3) as fr:
+        warm = [fr.submit([9, 8, 7], 2) for _ in range(2)]
+        fr.run_until_idle()
+        assert all(fr.state(f) == "finished" for f in warm)
+        fr.reset_stats()
+        got = _serve(fr, prompts, 6, plan="disagg_prefill_kill:2")
+        assert fr.stats["deaths"] >= 1
+        _assert_clean(fr)
+    assert got == want
+
+
+@pytest.mark.slow
+def test_disagg_dropped_handoff_is_reaped_and_replayed():
+    prompts = _prompts(3)
+    want = _oracle(prompts, 5)
+    with _fleet(["prefill", "decode", "decode"], heartbeat_s=30.0,
+                lease_ttl_s=0.2) as fr:
+        warm = [fr.submit([9, 8, 7], 2)]
+        fr.run_until_idle()
+        assert all(fr.state(f) == "finished" for f in warm)
+        fr.reset_stats()
+        got = _serve(fr, prompts, 5, plan="disagg_handoff_drop:1")
+        assert fr.stats["handoff.dropped"] >= 1
+        assert fr.handoff.stats["reaped"] >= 1
+        assert fr.stats["handoff.replays"] >= 1
+        _assert_clean(fr)
+    assert got == want
+
+
+@pytest.mark.slow
+def test_disagg_decode_kill_holding_adopted_pages_dedups_and_forfeits():
+    prompts = _prompts(4)
+    want = _oracle(prompts, 10)
+    with _fleet(["prefill", "decode", "decode"], heartbeat_s=0.3) as fr:
+        warm = [fr.submit([9, 8, 7], 2)]
+        fr.run_until_idle()
+        assert all(fr.state(f) == "finished" for f in warm)
+        fr.reset_stats()
+        fids = [fr.submit(p, 10) for p in prompts]
+        victim = None
+        for _ in range(3000):
+            fr.step()
+            victim = next(
+                (r for r in fr.replicas
+                 if r.alive and r.role == "decode"
+                 and r.engine.stats["adopts"] > 0
+                 and any(q.state == "running"
+                         for q in r.engine.requests.values())), None)
+            if victim is not None:
+                break
+        assert victim is not None, "no decode replica ever held a request"
+        fr.kill(victim.rid)
+        fr.run_until_idle()
+        assert all(fr.state(f) == "finished" for f in fids), \
+            {f: fr.state(f) for f in fids}
+        got = [fr.result(f) for f in fids]
+        assert fr.stats["deaths"] == 1
+        _assert_clean(fr)
+    assert got == want

@@ -22,6 +22,13 @@ acceptance bar, printed per rate.
     python tools/_serve_ab.py --pool-pages 64       # pressure the pool
     python tools/_serve_ab.py --fleet               # the ISSUE 16 fleet
                                                     # campaign (4 arms)
+    python tools/_serve_ab.py --disagg              # the ISSUE 19 disagg
+                                                    # campaign (co-located
+                                                    # vs prefill/decode
+                                                    # split vs mid-handoff
+                                                    # kill), gated via
+                                                    # gate.py --disagg over
+                                                    # DISAGG_r*.json
 
 Each rate prints one JSON line; the last line is the sweep summary.
 """
@@ -532,7 +539,8 @@ def _fleet_warm(fr, workload) -> None:
 
     horizon = max(len(p) + mn for _, p, mn in workload)
     for rep in fr.replicas:
-        rep.engine.warmup_decode(horizon)
+        if rep.role != "prefill":  # a prefill-stage engine never decodes
+            rep.engine.warmup_decode(horizon)
     saved_deadline = fr.monitor.deadline_s
     fr.monitor.deadline_s = 1e9
     try:
@@ -647,6 +655,115 @@ def fleet_block(on_tpu: bool, seed: int = 0, n_replicas: int = 4) -> dict:
     }
 
 
+def disagg_block(on_tpu: bool, seed: int = 0) -> dict:
+    """The ISSUE 19 acceptance campaign — three arms over the same seeded
+    trace, ALL on the inline pump (disaggregated fleets only pump inline,
+    so the co-located yardstick must too: one pump discipline, and TTFT
+    deltas measure the topology, not threading):
+
+      coloc    4 co-located mixed replicas, each on its own pool — the
+               yardstick the split is judged against
+      disagg   2 prefill + 2 decode replicas over ONE shared PagedKVPool
+               (every request crosses a transactional KV handoff); the
+               gate line is bounded p99 TTFT vs coloc and hard zeros on
+               lost/duplicates/leaks
+      kill     same split topology under a mid-handoff failure double:
+               one "prepared" handoff dropped on the router floor (the
+               lease reaper must reclaim + replay it) AND a mid-stream
+               SIGKILL of the most-loaded replica — zero lost, zero
+               duplicates, >= 1 reaped lease, no lease left PREPARED,
+               a clean shared-pool audit
+
+    The disagg arms size the SHARED pool at 4x the per-engine pool of the
+    coloc arm: same aggregate KV capacity, so pool pressure is comparable
+    and the TTFT delta isolates the handoff cost."""
+    from paddle_tpu.resilience.faults import fault_scope
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving.fleet import disagg_fleet_factory
+
+    cfg, prompt_lens, _ = ab_config(on_tpu, shared_prefix=False)
+    if on_tpu:
+        eng_kw = dict(page_size=16, pool_pages=1024, max_inflight=16)
+        n_req, max_new, rate = 64, 16, 32.0
+    else:
+        eng_kw = dict(page_size=4, pool_pages=64, max_inflight=4)
+        n_req, max_new, rate = 24, 24, 16.0
+    eng_kw.update(prefix_cache=True, draft_k=0, seed=seed)
+    wl = synth_workload(n_req, cfg.vocab_size, seed=seed,
+                        prompt_lens=prompt_lens, max_new=max_new, rate=rate)
+    hb = 0.5
+    roles = ["prefill", "prefill", "decode", "decode"]
+
+    def run_arm(split: bool, plan: str | None = None,
+                kill_at_frac: float | None = None, ttl=None):
+        if split:
+            fac = disagg_fleet_factory(
+                cfg, **{**eng_kw, "pool_pages": 4 * eng_kw["pool_pages"]})
+            router_kw = {"roles": list(roles), "lease_ttl_s": ttl}
+        else:
+            def fac():  # noqa: ANN202 — same engine recipe, private pools
+                return ServingEngine(cfg, **eng_kw)
+            router_kw = {}
+        with FleetRouter(fac, n_replicas=4, heartbeat_s=hb,
+                         pump="inline", **router_kw) as fr:
+            _fleet_warm(fr, wl)
+            if plan is not None:
+                with fault_scope(plan):
+                    fids, wall, rid = _drive_fleet(
+                        fr, wl, kill_at_frac=kill_at_frac)
+            else:
+                fids, wall, rid = _drive_fleet(
+                    fr, wl, kill_at_frac=kill_at_frac)
+            out = _fleet_arm_metrics(fr, fids, wall)
+            out["event_rid"] = rid
+            if fr.handoff is not None:
+                out["handoff"] = dict(fr.handoff.stats)
+                out["prefill_dispatches"] = fr.stats["prefill_dispatches"]
+                out["handoff_replays"] = fr.stats["handoff.replays"]
+                out["handoff_dropped"] = fr.stats["handoff.dropped"]
+                out["leases_left_prepared"] = fr.handoff.active()
+                out["pool_audit_problems"] = list(
+                    fr.handoff.pool.check_consistency(None))
+            return out
+
+    arms = {
+        "coloc": run_arm(split=False),
+        "disagg": run_arm(split=True),
+        # the drop fires on the 2nd prepared event (the 1st is often the
+        # very first request, whose replay timing is compile-shadowed)
+        "kill": run_arm(split=True, plan="disagg_handoff_drop:2",
+                        kill_at_frac=0.25, ttl=0.3),
+    }
+
+    def _ratio(a, b):
+        return round(a / max(b, 1e-9), 3)
+
+    p99_c = arms["coloc"]["ttft"]["p99_ms"]
+    p99_d = arms["disagg"]["ttft"]["p99_ms"]
+    kill = arms["kill"]
+    return {
+        "campaign": "disagg",
+        "arms": arms,
+        "roles": roles,
+        "cores": os.cpu_count(),
+        "heartbeat_s": hb,
+        "disagg_ttft_p99_ratio": (_ratio(p99_d, p99_c)
+                                  if p99_c and p99_d else None),
+        "disagg_tok_s_ratio": _ratio(arms["disagg"]["tok_s"],
+                                     arms["coloc"]["tok_s"]),
+        "kill_lost": kill["lost"],
+        "kill_duplicate_tokens": kill["duplicate_tokens"],
+        "kill_reaped_leases": kill["handoff"]["reaped"],
+        "kill_handoff_replays": kill["handoff_replays"],
+        "leaked_pages": sum(a["kv_pages_leaked"] for a in arms.values()),
+        "leases_left_prepared": sum(a.get("leases_left_prepared", 0)
+                                    for a in arms.values()),
+        "audit_problems": sum(len(a.get("pool_audit_problems", []))
+                              for a in arms.values()),
+        "config": f"n{n_req} max_new{max_new} r{rate:g} seed{seed}",
+    }
+
+
 def ab_config(on_tpu: bool, shared_prefix: bool):
     """(cfg, prompt_lens, user_lens) for the sweep. The shared-prefix CPU
     config is deliberately LESS tiny than decoder_tiny: at decoder_tiny
@@ -723,6 +840,11 @@ def main():
                          "retire) and print its JSON")
     ap.add_argument("--replicas", type=int, default=4,
                     help="fleet size for --fleet (default 4)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the ISSUE 19 three-arm disaggregation block "
+                         "(co-located / prefill-decode split / mid-handoff "
+                         "kill) and print its JSON (redirect to "
+                         "DISAGG_r*.json for gate.py --disagg)")
     args = ap.parse_args()
     if args.prefix_cache is not None:
         args.prefix_cache = bool(args.prefix_cache)
@@ -734,6 +856,9 @@ def main():
         print(json.dumps(fleet_block(on_tpu, seed=args.seed,
                                      n_replicas=args.replicas)),
               flush=True)
+        return
+    if args.disagg:
+        print(json.dumps(disagg_block(on_tpu, seed=args.seed)), flush=True)
         return
 
     cfg, prompt_lens, user_lens = ab_config(on_tpu, args.shared_prefix)

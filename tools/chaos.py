@@ -365,6 +365,118 @@ def run_fleet_drill(cycles: int = 3, n_req: int = 6, seed: int = 0,
     return out
 
 
+def run_disagg_drill(cycles: int = 3, n_req: int = 4, seed: int = 0,
+                     verbose: bool = False) -> dict:
+    """Disaggregation kill-wave drill (ISSUE 19): a 2-prefill + 2-decode
+    fleet over ONE shared PagedKVPool serves `cycles` waves, each under a
+    different seeded disagg fault — a prefill SIGKILL mid-wave, a dropped
+    handoff (lease published, commit never dispatched; the reaper must
+    reclaim and replay it), and the lease-expiry race at commit — plus a
+    final decode SIGKILL holding adopted pages. Every wave must end with
+    ZERO lost requests, greedy outputs byte-identical to the fault-free
+    single-engine oracle, ZERO leaked pages on every surviving engine, a
+    clean shared-pool audit, and no lease left PREPARED. Returns per-cycle
+    fired faults plus the router/handoff stats."""
+    from paddle_tpu.resilience import fault_scope
+    from paddle_tpu.serving import FleetRouter, ServingEngine
+    from paddle_tpu.serving import model as sv_model
+    from paddle_tpu.serving.fleet import disagg_fleet_factory
+
+    cfg = sv_model.decoder_tiny()
+    rng = np.random.default_rng(seed)
+    waves = []
+    for _ in range(cycles + 1):  # +1 for the decode-kill finale
+        prompts = [rng.integers(1, 97, size=int(rng.integers(3, 8))).tolist()
+                   for _ in range(n_req)]
+        waves.append((prompts, int(rng.integers(4, 9))))
+
+    oracle = ServingEngine(cfg, page_size=4, pool_pages=96, max_inflight=4,
+                           seed=seed, prefix_cache=True, draft_k=0)
+    want = []
+    for prompts, max_new in waves:
+        rids = [oracle.submit(p, max_new) for p in prompts]
+        oracle.run_until_drained()
+        want.append([oracle.result(r) for r in rids])
+        oracle.prune_finished()
+
+    factory = disagg_fleet_factory(cfg, page_size=4, pool_pages=96,
+                                   max_inflight=4, seed=seed, draft_k=0)
+    fr = FleetRouter(factory, 4,
+                     roles=["prefill", "prefill", "decode", "decode"],
+                     heartbeat_s=0.3, affinity=False, lease_ttl_s=0.5)
+    warm = [fr.submit([9, 8, 7], 2) for _ in range(2)]
+    fr.run_until_idle()
+    assert all(fr.state(f) == "finished" for f in warm)
+    fr.reset_stats()
+
+    def check_wave(cycle, site, fids):
+        states = {f: fr.state(f) for f in fids}
+        lost = {f: s for f, s in states.items() if s != "finished"}
+        assert not lost, f"cycle {cycle} ({site}): lost requests {lost}"
+        got = [fr.result(f) for f in fids]
+        assert got == want[cycle], (
+            f"cycle {cycle} ({site}): delivered streams diverged from the "
+            f"fault-free oracle")
+        assert fr.stats["replay_divergence"] == 0, \
+            "greedy replay must never disagree with the delivered ledger"
+        for rep in fr.replicas:
+            if rep.alive:
+                leaked = rep.engine.leaked_pages()
+                assert leaked == 0, (
+                    f"cycle {cycle}: replica {rep.rid} leaked {leaked}")
+        problems = list(fr.handoff.pool.check_consistency(None))
+        assert not problems, f"cycle {cycle}: dirty shared-pool audit " \
+                             f"{problems}"
+        assert fr.handoff.active() == 0, \
+            f"cycle {cycle}: {fr.handoff.active()} lease(s) left PREPARED"
+
+    scenarios = ["disagg_prefill_kill", "disagg_handoff_drop",
+                 "disagg_lease_expire_race"]
+    cycles_out = []
+    for cycle in range(cycles):
+        site = scenarios[cycle % len(scenarios)]
+        # keep a prefill survivor to replay onto before each kill wave
+        if sum(1 for r in fr.replicas
+               if r.alive and r.role == "prefill") < 2:
+            fr.add_replica("prefill")
+        prompts, max_new = waves[cycle]
+        fids = [fr.submit(p, max_new) for p in prompts]
+        with fault_scope(f"{site}:{2 + cycle}") as fp:
+            fr.run_until_idle()
+            fired = list(fp.stats()["fired"])
+        check_wave(cycle, site, fids)
+        if verbose:
+            print(f"cycle {cycle}: site={site} fired={fired} "
+                  f"deaths={fr.stats['deaths']} "
+                  f"reaped={fr.handoff.stats['reaped']} "
+                  f"commits={fr.handoff.stats['committed']}")
+        cycles_out.append({"site": site, "fired": fired,
+                           "states": {"finished": len(fids)}})
+    # finale: SIGKILL a decode replica HOLDING adopted pages mid-stream —
+    # the forfeit returns them, the ledger dedups the replay
+    prompts, max_new = waves[cycles]
+    fids = [fr.submit(p, max_new) for p in prompts]
+    victim = None
+    for _ in range(3000):
+        fr.step()
+        victim = next((r for r in fr.replicas
+                       if r.alive and r.role == "decode"
+                       and r.engine.stats["adopts"] > 0
+                       and any(q.state == "running"
+                               for q in r.engine.requests.values())), None)
+        if victim is not None:
+            break
+    assert victim is not None, "no decode replica ever held adopted work"
+    fr.kill(victim.rid)
+    fr.run_until_idle()
+    check_wave(cycles, "decode_kill_post_adopt", fids)
+    out = {"cycles": cycles_out, "stats": dict(fr.stats),
+           "handoff": dict(fr.handoff.stats),
+           "deaths": fr.stats["deaths"]}
+    fr.shutdown()
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -396,7 +508,29 @@ def main(argv=None) -> int:
                          "slow-heartbeat waves plus drain-and-retire over "
                          "the replica fleet; zero lost requests, zero "
                          "duplicate tokens, byte-exact greedy outputs")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregation kill-wave drill: prefill "
+                         "SIGKILL / dropped handoff / lease-expiry race "
+                         "waves plus a decode kill holding adopted pages "
+                         "over a 2-prefill+2-decode shared-pool fleet; "
+                         "zero lost requests, byte-exact outputs, zero "
+                         "leaked pages, clean audit every cycle")
     args = ap.parse_args(argv)
+
+    if args.disagg:
+        try:
+            out = run_disagg_drill(seed=args.seed, verbose=True)
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"DISAGG DRILL FAILED: {e}", file=sys.stderr)
+            return 1
+        h = out["handoff"]
+        print(f"OK: disagg fleet served {len(out['cycles'])} faulted "
+              f"wave(s) + decode kill — {out['deaths']} death(s), "
+              f"{h['granted']} lease(s) granted / {h['committed']} "
+              f"committed / {h['reaped']} reaped, "
+              f"{out['stats']['handoff.replays']} handoff replay(s), "
+              f"0 leaks, clean audit")
+        return 0
 
     if args.fleet:
         try:

@@ -32,6 +32,11 @@ every end-of-round snapshot commit:
                                            # only (SIGKILL arm hard zeros,
                                            # scaling floor, drain-and-retire,
                                            # bounded kill-arm TTFT)
+    python tools/gate.py --disagg [F.json] # disaggregated-serving campaign
+                                           # artifact only (handoff hard
+                                           # zeros, bounded split-arm TTFT
+                                           # vs co-located, >= 1 reaped
+                                           # lease + replay in the kill arm)
 """
 from __future__ import annotations
 
@@ -165,6 +170,22 @@ FLEET_CPU_OVERHEAD_FLOOR = 0.7
 # instead of impossible.
 FLEET_TTFT_CEIL_RATIO = 2.0
 FLEET_DETECT_BUDGET_BEATS = 4.0
+
+# disaggregated serving (ISSUE 19, `gate.py --disagg` over DISAGG_r*.json).
+# Hard zeros as for the fleet: no lost requests, no duplicate tokens, no
+# leaked pages, no lease left PREPARED, a clean shared-pool audit — and the
+# kill arm must have exercised the machinery (>= 1 reaped lease, >= 1
+# handoff replay). The split arm's p99 TTFT is bounded against co-located,
+# but a bare ratio would be dishonest: the split halves the DECODE capacity
+# by construction, so under open-loop load the first token queues for a
+# decode slot while the co-located yardstick (all 4 replicas decoding)
+# stays nearly unloaded. The ceiling therefore grants a queueing budget
+# proportional to the arm's own measured wall — the scale of one
+# generation wave through the halved decode stage — on top of the pure
+# ratio. A genuine pathology (handoffs stalling to the lease TTL, commits
+# lost and re-reaped) blows past wall-scale TTFT and still fails.
+DISAGG_TTFT_CEIL_RATIO = 3.0
+DISAGG_QUEUE_BUDGET_WALL_FRAC = 0.5
 
 
 def run_suite() -> int:
@@ -762,6 +783,91 @@ def check_fleet(path: str | None = None) -> int:
     return rc
 
 
+def check_disagg(path: str | None = None) -> int:
+    """`--disagg`: gate the newest (or given) DISAGG_r*.json campaign
+    artifact (ISSUE 19, tools/_serve_ab.py --disagg). Hard zeros across
+    every arm — lost requests, duplicate tokens, leaked pages, leases left
+    PREPARED, shared-pool audit problems — then the split arm's bounded
+    p99 TTFT vs co-located (ratio + queueing budget, see the constants)
+    and proof the kill arm exercised the orphan-recovery machinery:
+    >= 1 reaped lease and >= 1 handoff replay."""
+    arts = sorted(glob.glob(os.path.join(REPO, "DISAGG_r*.json")))
+    if path is None:
+        if not arts:
+            print("[gate] WARN: no DISAGG_r*.json artifact", flush=True)
+            return 0
+        path = arts[-1]
+    label = os.path.basename(path)
+    try:
+        with open(path) as f:
+            data = json.loads(f.read())
+    except (OSError, ValueError) as e:
+        print(f"[gate] WARN: cannot read disagg artifact {path}: {e}",
+              flush=True)
+        return 0
+    if not isinstance(data, dict) or "arms" not in data:
+        print(f"[gate] WARN: {label} carries no disagg arms — skipped",
+              flush=True)
+        return 0
+    rc = 0
+    arms = data.get("arms") or {}
+    for arm, row in sorted(arms.items()):
+        for key, what in (
+                ("lost", "lost requests"),
+                ("duplicate_tokens", "duplicate delivered tokens"),
+                ("kv_pages_leaked", "leaked KV pages"),
+                ("replay_divergence", "diverging replayed tokens"),
+                ("leases_left_prepared", "leases left PREPARED")):
+            if row.get(key):
+                print(f"[gate] FAIL: disagg arm '{arm}' recorded "
+                      f"{row[key]} {what} — the handoff protocol must "
+                      f"hold its hard zeros", flush=True)
+                rc = 1
+        if row.get("pool_audit_problems"):
+            print(f"[gate] FAIL: disagg arm '{arm}' left a dirty "
+                  f"shared-pool audit: {row['pool_audit_problems'][:4]}",
+                  flush=True)
+            rc = 1
+    kill = arms.get("kill") or {}
+    print(f"[gate] disagg {label}: coloc "
+          f"{arms.get('coloc', {}).get('tok_s')} -> split "
+          f"{arms.get('disagg', {}).get('tok_s')} tok/s "
+          f"(x{data.get('disagg_tok_s_ratio')}); ttft p99 "
+          f"x{data.get('disagg_ttft_p99_ratio')}; kill arm lost "
+          f"{data.get('kill_lost')}, dup "
+          f"{data.get('kill_duplicate_tokens')}, reaped "
+          f"{data.get('kill_reaped_leases')} lease(s), "
+          f"{data.get('kill_handoff_replays')} replay(s)", flush=True)
+    if not kill.get("handoff", {}).get("reaped"):
+        print("[gate] FAIL: the mid-handoff kill arm reaped no lease — "
+              "the orphan-recovery path never engaged, the artifact "
+              "measured nothing", flush=True)
+        rc = 1
+    if not data.get("kill_handoff_replays"):
+        print("[gate] FAIL: the kill arm replayed no handoff — a reaped "
+              "lease must turn into a replay, not a lost request",
+              flush=True)
+        rc = 1
+    coloc_p99 = ((arms.get("coloc") or {}).get("ttft") or {}).get("p99_ms")
+    for arm in ("disagg", "kill"):
+        row = arms.get(arm) or {}
+        p99 = (row.get("ttft") or {}).get("p99_ms")
+        wall_ms = 1000.0 * float(row.get("wall_s") or 0.0)
+        if p99 is None or coloc_p99 is None:
+            continue
+        ceil_ms = (DISAGG_TTFT_CEIL_RATIO * coloc_p99
+                   + DISAGG_QUEUE_BUDGET_WALL_FRAC * wall_ms)
+        if p99 > ceil_ms:
+            print(f"[gate] FAIL: the '{arm}' arm's p99 TTFT is {p99}ms vs "
+                  f"a ceiling of {DISAGG_TTFT_CEIL_RATIO}x the co-located "
+                  f"arm ({coloc_p99}ms) + a "
+                  f"{DISAGG_QUEUE_BUDGET_WALL_FRAC:g}x-wall queueing "
+                  f"budget ({wall_ms:g}ms wall) — handoffs are stalling "
+                  f"first tokens beyond decode-slot queueing", flush=True)
+            rc = 1
+    return rc
+
+
 def _check_obs(data: dict, label: str, require: bool = False) -> int:
     """Telemetry-block gate (ISSUE 13). Three failure modes:
       * missing block (only when `require` — artifacts predating the layer
@@ -1002,6 +1108,9 @@ def main() -> int:
     if "--fleet" in sys.argv:
         arg = sys.argv[sys.argv.index("--fleet") + 1:]
         return check_fleet(arg[0] if arg else None)
+    if "--disagg" in sys.argv:
+        arg = sys.argv[sys.argv.index("--disagg") + 1:]
+        return check_disagg(arg[0] if arg else None)
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
@@ -1010,6 +1119,7 @@ def main() -> int:
         rc = rc or check_multichip()
         rc = rc or check_costmodel()
         rc = rc or check_fleet()
+        rc = rc or check_disagg()
     if rc == 0:
         print("[gate] OK — green suite, safe to snapshot")
     return rc
