@@ -395,6 +395,34 @@ _define("disagg_lease_ttl_s", 2.0,
         "under the normal failover budget. Scaled by FLAGS_watchdog_scale "
         "(slow CI must not reap healthy handoffs); commits that lose the "
         "expiry race are rejected atomically, never half-adopted")
+# learned serving control (serving/control/ — see README "Learned serving
+# control")
+_define("serve_control_mode", "shadow",
+        "the learned serving controller: 'off' disables observation "
+        "entirely; 'shadow' (default) observes regimes, proposes knob "
+        "configs and logs/counts them but never applies one; 'apply' "
+        "stages confident proposals for adoption at the next safe "
+        "boundary (engine idle gap / router epoch tick), re-running "
+        "warmup_decode when the decode bucket geometry changes")
+_define("serve_control_store", "",
+        "measurement-store path for serving.control regime rows; empty "
+        "falls back to the tuning store (FLAGS_tuning_measurements / "
+        "derived from FLAGS_tuning_db) — kernels and regimes share one "
+        "append-only dataset unless split out")
+_define("serve_control_model", "",
+        "trained control-model artifact; empty falls back to "
+        "FLAGS_tuning_model (the serving.control group ships inside the "
+        "same tools/costmodel.py artifact). Missing = hand flags; corrupt "
+        "warns once and fails open to the hand flags")
+_define("serve_control_conf", 0.6,
+        "confidence threshold: a control proposal stands only when the "
+        "trained group's holdout rank accuracy clears this floor (the "
+        "stricter of this and the model-wide gate); below it every "
+        "regime serves the hand-flag config")
+_define("serve_control_epoch_s", 5.0,
+        "controller epoch interval in seconds: regimes are observed, "
+        "realized goodput recorded and proposals made at most once per "
+        "epoch per engine. <=0 disables the tick entirely")
 # tiered giant-embedding knobs (paddle_tpu/embedding/, the minimize()-time
 # rewrite in passes.rewrite_tiered_embeddings — see README "Tiered
 # embeddings")

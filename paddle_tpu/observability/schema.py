@@ -204,6 +204,30 @@ DECLARED: list[tuple] = [
     ("fleet.lease.active", GAUGE, "leases currently PREPARED", ()),
     ("fleet.lease.pinned_pages", GAUGE,
      "shared-pool pages currently pinned by leases (in transit)", ()),
+    # -- learned serving control (serving/control/, ISSUE 20) ---------------
+    ("serving.control.proposals", COUNTER,
+     "knob-config proposals resolved, by tier (learned = a gated ridge "
+     "prediction stood; hand = the flag config served)", ("tier",)),
+    ("serving.control.fallbacks", COUNTER,
+     "proposals that fell back to the hand flags, by reason (no_model/"
+     "no_group/accuracy/envelope/features/off/...)", ("reason",)),
+    ("serving.control.staged", COUNTER,
+     "apply-mode proposals staged as a pending EngineConfig", ()),
+    ("serving.control.applies", COUNTER,
+     "pending EngineConfigs adopted at a safe boundary (engine idle gap "
+     "/ router epoch tick)", ()),
+    ("serving.control.rewarmups", COUNTER,
+     "warmup_decode re-runs forced by an adopted bucket-geometry change "
+     "(keeps XLA compiles off the serving path)", ()),
+    ("serving.control.regime", GAUGE,
+     "current traffic-regime id (stable hash bucket of the regime key)",
+     ()),
+    ("serving.control.goodput_rel_err", HISTOGRAM,
+     "realized-vs-predicted goodput relative error per controller epoch "
+     "(the controller grading its own prior)", ()),
+    ("serving.control.actuation", EVENT,
+     "actuation lifecycle record (staged/adopted, geometry change, "
+     "rewarm)", ()),
     # -- training step telemetry (executor.py async window) -----------------
     ("train.steps", COUNTER, "async steps drained to completion", ()),
     ("train.step_latency_s", HISTOGRAM,

@@ -84,6 +84,8 @@ from __future__ import annotations
 
 import time
 
+import dataclasses
+
 import numpy as np
 
 from .. import flags, unique_name
@@ -100,7 +102,7 @@ from .kv_cache import (OwnedPoolView, PagedKVPool, PrefixCache,
 from .sampling import SamplingParams, request_rng, sample_token
 
 __all__ = ["GenRequest", "ContinuousBatchingScheduler", "ServingEngine",
-           "AdmissionRejected", "ngram_draft"]
+           "EngineConfig", "AdmissionRejected", "ngram_draft"]
 
 WAITING, RUNNING, FINISHED, ABORTED = "waiting", "running", "finished", "aborted"
 DEADLINE_EXCEEDED, SHED = "deadline_exceeded", "shed"
@@ -136,6 +138,35 @@ class _StepFailure(RuntimeError):
         super().__init__(f"{kind} dispatch failed after retries: {cause}")
         self.kind = kind
         self.cause = cause
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """One immutable snapshot of every runtime knob the engine consults
+    while scheduling (ISSUE 20). The ctor resolves flags into ONE of these;
+    every hot-path read goes through it, so a mid-request flag flip — or a
+    controller actuation — can never tear a request's config: the only way
+    a knob changes is `propose_config` staging a replacement snapshot that
+    `maybe_adopt_config` swaps in whole at a safe boundary (no in-flight
+    work). Construction-only knobs (page/pool geometry, tp, scheduler
+    policy, prefix-cache presence) stay plain attributes — no actuation
+    path exists for them."""
+
+    max_inflight: int
+    draft_k: int
+    deadline_s: float
+    priority_default: int
+    shed_occupancy: float
+    shed_queue_depth: int
+    shed_ttft_p99_ms: float
+    degrade_after: int
+    audit_every: int
+
+    def bucket_geometry(self) -> tuple:
+        """What the decode-signature lattice depends on: the batch-bucket
+        ceiling and the window program's draft width. A pending config
+        with a different geometry re-runs warmup_decode on adoption."""
+        return (_round_up_pow2(max(1, self.max_inflight)), self.draft_k)
 
 
 def ngram_draft(tokens, k: int, window: int = 128) -> list[int]:
@@ -274,41 +305,48 @@ class ServingEngine:
                              or flags.get_flag("serving_page_size"))
         self.pool_pages = int(pool_pages
                               or flags.get_flag("serving_pool_pages"))
-        self.max_inflight = int(max_inflight
-                                or flags.get_flag("serving_max_inflight"))
         self.scheduler = ContinuousBatchingScheduler(
             policy or str(flags.get_flag("serving_sched_policy")))
         if prefix_cache is None:
             prefix_cache = bool(flags.get_flag("serving_prefix_cache"))
-        self.draft_k = int(draft_k if draft_k is not None
-                           else flags.get_flag("serving_draft_k"))
         self.tp = int(tp if tp is not None else flags.get_flag("serving_tp"))
+        self.seed = int(seed)
+        # runtime knobs resolve ONCE into an immutable EngineConfig
+        # snapshot (ISSUE 20): the scheduling loop reads self._ecfg, never
+        # the flags — a flag flipped mid-request changes nothing until an
+        # explicit propose/adopt cycle swaps the whole snapshot at a safe
+        # boundary. Resilience defaults keep the machinery off/cheap.
+        self._ecfg = EngineConfig(
+            max_inflight=int(max_inflight
+                             or flags.get_flag("serving_max_inflight")),
+            draft_k=int(draft_k if draft_k is not None
+                        else flags.get_flag("serving_draft_k")),
+            deadline_s=float(
+                deadline_s if deadline_s is not None
+                else flags.get_flag("serving_deadline_s")),
+            priority_default=int(
+                priority_default if priority_default is not None
+                else flags.get_flag("serving_priority_default")),
+            shed_occupancy=float(
+                shed_occupancy if shed_occupancy is not None
+                else flags.get_flag("serving_shed_occupancy")),
+            shed_queue_depth=int(
+                shed_queue_depth if shed_queue_depth is not None
+                else flags.get_flag("serving_shed_queue_depth")),
+            shed_ttft_p99_ms=float(
+                shed_ttft_p99_ms if shed_ttft_p99_ms is not None
+                else flags.get_flag("serving_shed_ttft_p99_ms")),
+            degrade_after=max(1, int(
+                degrade_after if degrade_after is not None
+                else flags.get_flag("serving_degrade_after"))),
+            audit_every=int(
+                audit_every if audit_every is not None
+                else flags.get_flag("serving_audit_every")),
+        )
+        self._pending_ecfg: EngineConfig | None = None
+        self._warm_ctx: int | None = None
         if self.draft_k < 0:
             raise ValueError(f"draft_k must be >= 0, got {self.draft_k}")
-        self.seed = int(seed)
-        # resilience knobs (ISSUE 14): deadlines, shedding, supervision —
-        # every default keeps the machinery off/cheap (see flags.py)
-        self.deadline_s = float(
-            deadline_s if deadline_s is not None
-            else flags.get_flag("serving_deadline_s"))
-        self.priority_default = int(
-            priority_default if priority_default is not None
-            else flags.get_flag("serving_priority_default"))
-        self.shed_occupancy = float(
-            shed_occupancy if shed_occupancy is not None
-            else flags.get_flag("serving_shed_occupancy"))
-        self.shed_queue_depth = int(
-            shed_queue_depth if shed_queue_depth is not None
-            else flags.get_flag("serving_shed_queue_depth"))
-        self.shed_ttft_p99_ms = float(
-            shed_ttft_p99_ms if shed_ttft_p99_ms is not None
-            else flags.get_flag("serving_shed_ttft_p99_ms"))
-        self.degrade_after = max(1, int(
-            degrade_after if degrade_after is not None
-            else flags.get_flag("serving_degrade_after")))
-        self.audit_every = int(
-            audit_every if audit_every is not None
-            else flags.get_flag("serving_audit_every"))
         retries = int(step_retries if step_retries is not None
                       else flags.get_flag("serving_step_retries"))
         self._retry = serving_policy(max_attempts=max(1, retries),
@@ -425,7 +463,116 @@ class ServingEngine:
             "recovery.replayed": 0, "recovery.quarantined": 0,
             "ladder.spec_off": 0, "ladder.lookahead_shrink": 0,
             "ladder.cache_evict": 0, "ladder.shed": 0,
+            # learned serving control (ISSUE 20)
+            "control.applies": 0, "control.rewarmups": 0,
         }
+        # the learned controller's per-engine epoch hook (ISSUE 20):
+        # shadow by default — one perf_counter read per step until an
+        # epoch is due, then observe/propose/log (apply mode additionally
+        # stages a pending EngineConfig for the next safe boundary)
+        from . import control as sv_control
+
+        self._ctrl = sv_control.Controller()
+
+    # -- runtime knobs: the EngineConfig snapshot (ISSUE 20) ----------------
+    # Compatibility properties: every pre-existing `engine.<knob>` read —
+    # internal hot paths and external harnesses alike — resolves through
+    # the one immutable snapshot.
+    @property
+    def engine_config(self) -> EngineConfig:
+        return self._ecfg
+
+    @property
+    def max_inflight(self) -> int:
+        return self._ecfg.max_inflight
+
+    @property
+    def draft_k(self) -> int:
+        return self._ecfg.draft_k
+
+    @property
+    def deadline_s(self) -> float:
+        return self._ecfg.deadline_s
+
+    @property
+    def priority_default(self) -> int:
+        return self._ecfg.priority_default
+
+    @property
+    def shed_occupancy(self) -> float:
+        return self._ecfg.shed_occupancy
+
+    @property
+    def shed_queue_depth(self) -> int:
+        return self._ecfg.shed_queue_depth
+
+    @property
+    def shed_ttft_p99_ms(self) -> float:
+        return self._ecfg.shed_ttft_p99_ms
+
+    @property
+    def degrade_after(self) -> int:
+        return self._ecfg.degrade_after
+
+    @property
+    def audit_every(self) -> int:
+        return self._ecfg.audit_every
+
+    def propose_config(self, knobs: dict, source: str = "controller") -> bool:
+        """Stage a knob change (controller proposal or operator nudge) as
+        a PENDING EngineConfig. Nothing changes here: the pending snapshot
+        waits for `maybe_adopt_config` at a safe boundary. Only the
+        online-actuatable knobs are honored (mi/dk/sq/so/da — see
+        control/knobs.py); construction-only fields keep their values.
+        Returns True when a pending config was staged (i.e. the proposal
+        differs from the current snapshot)."""
+        cur = self._ecfg
+        cand = dataclasses.replace(
+            cur,
+            max_inflight=max(1, int(knobs.get("mi", cur.max_inflight))),
+            draft_k=max(0, int(knobs.get("dk", cur.draft_k))),
+            shed_queue_depth=max(0, int(knobs.get("sq",
+                                                  cur.shed_queue_depth))),
+            shed_occupancy=min(1.0, max(0.0, float(
+                knobs.get("so", cur.shed_occupancy * 100)) / 100.0)),
+            degrade_after=max(1, int(knobs.get("da", cur.degrade_after))),
+        )
+        if cand == cur:
+            self._pending_ecfg = None
+            return False
+        self._pending_ecfg = cand
+        obs.event("serving.control.actuation",
+                  {"phase": "staged", "source": source,
+                   "geometry_change": cand.bucket_geometry()
+                   != cur.bucket_geometry()})
+        return True
+
+    def maybe_adopt_config(self) -> bool:
+        """Adopt the pending EngineConfig — but ONLY at a safe boundary:
+        no waiting and no running requests (the engine idle gap; the
+        fleet replica pump and submit()/step() all call this, so the gap
+        is found wherever it opens). When the decode bucket geometry
+        changed, re-runs `warmup_decode` over the previously warmed
+        context range so the next measured pass still triggers zero fresh
+        XLA compiles — no stray compile ever lands on the serving path."""
+        pend = self._pending_ecfg
+        if pend is None or self.has_work():
+            return False
+        old = self._ecfg
+        self._ecfg = pend
+        self._pending_ecfg = None
+        self._count("control.applies")
+        rewarmed = False
+        if (pend.bucket_geometry() != old.bucket_geometry()
+                and self._warm_ctx is not None):
+            self.warmup_decode(self._warm_ctx)
+            self._count("control.rewarmups")
+            rewarmed = True
+        obs.event("serving.control.actuation",
+                  {"phase": "adopted", "rewarmed": rewarmed,
+                   "max_inflight": pend.max_inflight,
+                   "draft_k": pend.draft_k})
+        return True
 
     def warmup_decode(self, max_context: int | None = None) -> int:
         """Precompile the decode-step signature lattice for contexts up to
@@ -439,6 +586,9 @@ class ServingEngine:
         count."""
         max_context = min(int(max_context or self.cfg.max_position),
                           self.cfg.max_position)
+        # remembered so a controller actuation that changes the bucket
+        # geometry can re-warm the SAME context range before serving
+        self._warm_ctx = max_context
         pbs = sorted({_round_up_pow2(self.pool.pages_for(c))
                       for c in range(1, max_context + 2)})
         bbs = sorted({_round_up_pow2(b)
@@ -541,6 +691,10 @@ class ServingEngine:
         strictly lower priority to make room, and raises AdmissionRejected
         with a retry-after hint when that is not enough — explicit refusal
         instead of an unbounded queue."""
+        # the admit boundary is a safe boundary: nothing in flight means a
+        # staged controller config can swap in before this request's
+        # admission reads any knob
+        self.maybe_adopt_config()
         if len(prompt) + max_new_tokens > self.cfg.max_position:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
@@ -552,6 +706,20 @@ class ServingEngine:
         if deadline_s is None:
             deadline_s = self.deadline_s
         sig = self._overload_signals()
+        if "occupancy" in sig and self.prefix_cache is not None:
+            # evictable prefix-cache pages are reclaimable memory, not
+            # pressure: free enough of the LRU tail to get back under the
+            # floor before refusing admission. Without this, a cache that
+            # grew to the pool size while the engine drained would shed
+            # every future submit with no step ever running — the rung-3
+            # eviction only fires on pressured STEPS, so an idle engine
+            # could never climb out (admission starvation the ISSUE 20
+            # knob sweep's engine reuse exposed).
+            floor_pages = int(self.shed_occupancy * self.pool.num_pages)
+            need = self.pool.pages_in_use - floor_pages + 1
+            if need > 0:
+                self.prefix_cache.evict(need)
+            sig = self._overload_signals()
         while sig and self._shed_one(max_priority=int(priority)):
             sig = self._overload_signals()
         if sig:
@@ -755,11 +923,16 @@ class ServingEngine:
         (quarantine + pool rebuild + prompt replay) instead of a poisoned
         batch."""
         self._step_i += 1
+        self.maybe_adopt_config()
         try:
-            return self._step_inner()
+            progressed = self._step_inner()
         except _StepFailure as e:
             self._recover(f"step_fail:{e.kind}")
-            return True
+            progressed = True
+        # controller epoch hook: one perf_counter read + compare per step
+        # until an epoch is due (the shadow-mode 0% overhead budget)
+        self._ctrl.tick(self)
+        return progressed
 
     def _step_inner(self) -> bool:
         try:

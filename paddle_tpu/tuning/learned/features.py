@@ -28,6 +28,10 @@ FAMILIES = {
     "attention": "backend",
     "epilogue": "backend",
     "xent": "backend",
+    # serving control (ISSUE 20): the "shape" is a traffic regime and the
+    # "arm" is a canonical knob-config spelling — the same store rows and
+    # ridge fit rank serving configs the way they rank conv lowerings
+    "serving.control": "knobs",
 }
 
 _DTYPE_BYTES = {
@@ -90,11 +94,19 @@ _EPI_FEATURES = (
     "log_rows", "log_c", "log_elems", "fill_c", "ch_last", "has_res",
     "act_identity", "kind_bn", "itemsize")
 _XENT_FEATURES = ("log_rows", "log_v", "log_elems", "fill_v", "itemsize")
+# serving.control regime keys (serving/control/regime.py spells them):
+# arrival rate, prompt-length percentiles, output budget, prefix-hit rate,
+# pool occupancy, queue depth, TTFT/SLO headroom — ratios arrive as percent
+# ints so the spelling stays canonical-integer like every other shape key
+_CTRL_FEATURES = (
+    "log_rate", "log_p50", "log_p95", "log_out", "hit", "occ", "log_q",
+    "headroom")
 
 
 def feature_names(op: str) -> tuple | None:
     return {"conv2d": _CONV_FEATURES, "attention": _ATTN_FEATURES,
-            "epilogue": _EPI_FEATURES, "xent": _XENT_FEATURES}.get(op)
+            "epilogue": _EPI_FEATURES, "xent": _XENT_FEATURES,
+            "serving.control": _CTRL_FEATURES}.get(op)
 
 
 def featurize(op: str, shape_key: str, dtype: str) -> list | None:
@@ -146,6 +158,14 @@ def featurize(op: str, shape_key: str, dtype: str) -> list | None:
         if op == "xent":
             rows, v = kv["rows"], kv["v"]
             return [_log(rows), _log(v), _log(rows * v), _fill(v), it]
+        if op == "serving.control":
+            return [
+                _log(float(kv["rate"])), _log(float(kv["p50"])),
+                _log(float(kv["p95"])), _log(float(kv["out"])),
+                float(kv["hit"]) / 100.0, float(kv["occ"]) / 100.0,
+                _log(float(kv["q"]) + 1.0),
+                float(kv.get("hr", 100)) / 100.0,
+            ]
     except (KeyError, TypeError, ValueError):
         return None
     return None

@@ -339,6 +339,10 @@ def run_overload_arm(engine, workload, max_steps: int = 200_000,
         "kv_pages_leaked": leaked,
         "refcount_leaks": refcount_leaks,
         "measured_pass_compiles": n_compiles,
+        # regime signals for the control sweep (ISSUE 20): what the pass
+        # actually saw, so every knob arm of one regime records one key
+        "prefix_cache_hit_rate": round(ss["prefix_cache_hit_rate"], 4),
+        "kv_pool_occupancy_mean": round(ss["occupancy_mean"], 4),
     }
 
 
@@ -764,6 +768,325 @@ def disagg_block(on_tpu: bool, seed: int = 0) -> dict:
     }
 
 
+def _control_geometry(on_tpu: bool):
+    """(eng_base, n_req, base_rate, hand_mi) — the PR 13 overload-bench
+    engine geometry, shared verbatim by the knob sweep and the control
+    A/B so the sweep's rows describe exactly the machine the bench
+    judges proposals on."""
+    if on_tpu:
+        return dict(page_size=16, pool_pages=2048), 64, 32.0, 16
+    return dict(page_size=4, pool_pages=64), 32, 8.0, 4
+
+
+def _control_hand_knobs(hand_mi: int):
+    """The PR 13 bench configs as knob spellings: the no-floor unloaded
+    reference and the shed-floored overload reference. These are the arms
+    the learned tier must beat (or tie) — and the fallback every gated
+    proposal resolves to."""
+    un = {"mi": hand_mi, "dk": 0, "pc": 1, "sp": 0,
+          "sq": 0, "so": 0, "da": 4, "pd": 0}
+    ov = {"mi": hand_mi, "dk": 0, "pc": 1, "sp": 0,
+          "sq": 8, "so": 95, "da": 2, "pd": 0}
+    return un, ov
+
+
+class _ArmPool:
+    """One live engine per construction-only knob combo (pc, sp); the
+    actuatable knobs move between arms through the engine's own staged
+    config path (propose_config + idle adoption). Two birds: every arm
+    after the first rides warm XLA caches (a cold CPU engine pays ~30 s
+    of compiles for a sub-second measured pass), and the sweep itself
+    exercises the actuator it is collecting data for."""
+
+    def __init__(self, cfg, eng_base: dict, seed: int):
+        self._cfg, self._base, self._seed = cfg, dict(eng_base), seed
+        self._engines: dict = {}
+
+    def engine_for(self, knobs: dict):
+        from paddle_tpu.serving import ServingEngine
+        from paddle_tpu.serving import control as sv_control
+
+        key = (knobs["pc"], knobs["sp"])
+        eng = self._engines.get(key)
+        if eng is None:
+            kw = dict(self._base)
+            kw.update(sv_control.engine_kwargs(knobs))
+            eng = self._engines[key] = ServingEngine(
+                self._cfg, seed=self._seed, **kw)
+        else:
+            eng.propose_config(
+                {f: knobs[f] for f in sv_control.ACTUATABLE}, source="sweep")
+            eng.maybe_adopt_config()
+            eng.prune_finished()
+            # drop retained prefix pages from earlier arms/regimes: a
+            # reused engine otherwise drags the last regime's shared
+            # prefixes into this one's pool, and on the small CPU pool
+            # that residue alone trips the occupancy shed floor — every
+            # so>0 arm would measure a starved pool, not its knobs (the
+            # warmup replay re-warms THIS workload's prefixes before the
+            # measured pass, exactly like the bench's fresh engines)
+            if eng.prefix_cache is not None:
+                eng.prefix_cache.flush()
+        got = sv_control.knob_key(sv_control.engine_knobs(eng))
+        want = sv_control.knob_key(dict(knobs, pd=0))
+        if got != want:
+            raise RuntimeError(f"arm-pool actuation drifted: {got} != {want}")
+        return eng
+
+
+def _regime_sig(wl, rate: float, hand_block: dict) -> dict:
+    """Regime signals for one sweep workload: intent (arrival rate,
+    length percentiles, output budget) from the seeded trace, runtime
+    signals (prefix hit, occupancy, queueing proxy, shed headroom) from
+    the hand-reference pass — so every knob arm of the regime records
+    under ONE store key, which is what lets the ridge rank arms."""
+    from paddle_tpu.serving import control as sv_control
+
+    shed_frac = ((hand_block["shed"] + hand_block["rejected"])
+                 / max(hand_block["offered"], 1))
+    hr = 1.0 if shed_frac == 0 else (0.5 if shed_frac < 0.3 else 0.0)
+    p50_ttft_s = (hand_block["admitted_ttft"].get("p50_ms") or 0.0) / 1e3
+    return sv_control.workload_signals(
+        wl, rate,
+        hit=hand_block.get("prefix_cache_hit_rate", 0.0),
+        occ=hand_block.get("kv_pool_occupancy_mean", 0.0),
+        q=int(round(rate * p50_ttft_s)),  # Little's law queue proxy
+        hr=hr)
+
+
+def sweep_knobs_block(on_tpu: bool, seed: int = 0, store: str | None = None,
+                      n_arms: int = 6) -> dict:
+    """The ISSUE 20 knob sweep: measure every sweep arm's goodput across
+    a 12-regime grid (arrival-rate multiple x output budget x shared-
+    prefix length) and append one store row per (regime, arm). The grid
+    CONTAINS the PR 13 bench regimes (mult 1 and 10 at max_new 12,
+    sys_len 6 pages), so the trained envelope covers the traffic the
+    control A/B later judges proposals on — a prediction there is an
+    interpolation, never an extrapolation the envelope gate must kill."""
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu.serving import control as sv_control
+
+    cfg, _, user_lens = ab_config(on_tpu, shared_prefix=True)
+    eng_base, n_req, base_rate, hand_mi = _control_geometry(on_tpu)
+    ps = eng_base["page_size"]
+    hand_un, hand_ov = _control_hand_knobs(hand_mi)
+    # the shed-floored hand config leads (it is the sig reference pass);
+    # the no-floor hand config always measures too
+    arms = sv_control.sweep_arms(n_arms, seed=seed, include=hand_ov)
+    if not any(sv_control.knob_key(a) == sv_control.knob_key(hand_un)
+               for a in arms):
+        arms.insert(1, hand_un)
+    pool = _ArmPool(cfg, eng_base, seed)
+    old_rec = str(pt_flags.get_flag("tuning_record"))
+    pt_flags.set_flags({"tuning_record": "on"})
+    regimes, rows = [], 0
+    try:
+        for mult in (1, 3, 10):
+            for max_new in (6, 12):
+                for sys_pages in (3, 6):
+                    rate = base_rate * mult
+                    wl = synth_shared_prefix_workload(
+                        n_req, cfg.vocab_size, seed=seed, n_sys_prompts=8,
+                        sys_len=sys_pages * ps, user_lens=user_lens,
+                        max_new=max_new, rate=rate)
+                    sig = None
+                    by_arm = {}
+                    for knobs in arms:
+                        blk = run_overload_arm(pool.engine_for(knobs), wl)
+                        if sig is None:  # first arm is the hand reference
+                            sig = _regime_sig(wl, rate, blk)
+                        gp = blk["goodput_tok_s"]
+                        by_arm[sv_control.knob_key(knobs)] = round(gp, 2)
+                        if gp > 0 and sv_control.record_row(
+                                sig, knobs, gp, source="sweep", tool=True,
+                                path=store,
+                                extras={"sweep_seed": seed}):
+                            rows += 1
+                    reg = {"regime": sv_control.regime_key(sig),
+                           "rate": rate, "max_new": max_new,
+                           "sys_len": sys_pages * ps,
+                           "goodput_by_arm": by_arm}
+                    regimes.append(reg)
+                    print(json.dumps(reg), flush=True)
+    finally:
+        pt_flags.set_flags({"tuning_record": old_rec})
+    return {
+        "campaign": "control_sweep",
+        "store": os.path.abspath(store) if store
+        else sv_control.store_path(),
+        "rows_recorded": rows,
+        "n_regimes": len(regimes),
+        "arms": [sv_control.knob_key(a) for a in arms],
+        "regimes": regimes,
+        "config": f"shared-prefix n{n_req} r{base_rate:g}x(1,3,10) seed{seed}",
+    }
+
+
+def _goodput_pass(engine, workload) -> float:
+    """One already-warm measured pass: goodput tokens per wall second."""
+    engine.reset_stats()
+    engine.prune_finished()
+    rids, _rej, wall = _drive_overload(engine, workload, 200_000)
+    done = [engine.requests[r] for r in rids
+            if engine.requests[r].state == "finished"]
+    tok = sum(r.n_generated for r in done)
+    return tok / wall if wall > 0 else 0.0
+
+
+def control_block(on_tpu: bool, seed: int = 0,
+                  store: str | None = None) -> dict:
+    """The ISSUE 20 acceptance campaign. Trains the serving.control group
+    from the sweep store, then replays the PR 13 overload bench as a
+    hand-vs-learned A/B per arm:
+
+      unloaded          r8, no floors — the learned proposal must NOT
+                        regress this arm (tie band in the gate)
+      overload          10x with shed floors — learned must meet or beat
+      overload_faulted  10x under the bounded fault plan — same bar
+
+    plus the shadow-overhead A/B (PR 12 methodology: same warm engine,
+    same trace, mode off vs shadow interleaved, best-of-N per mode) on
+    the compute-bound overload trace — the arrival-limited unloaded
+    trace would hide any overhead in its idle sleeps.
+
+    Redirect to CONTROL_r*.json for gate.py --control."""
+    import tempfile as _tempfile
+
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu import tuning as _tuning
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving import control as sv_control
+    from paddle_tpu.tuning import learned
+
+    store_abs = os.path.abspath(store) if store else sv_control.store_path()
+    recs = list(learned.iter_records(store_abs))
+    ctrl_recs = [r for r in recs if r.get("op") == sv_control.CONTROL_OP]
+    if not ctrl_recs:
+        raise SystemExit(f"[control] no serving.control rows in "
+                         f"{store_abs!r} — run --sweep-knobs first")
+    model = learned.train_model(recs, seed=seed)
+    dev = _tuning.device_kind()
+    group = model.get("groups", {}).get(f"{sv_control.CONTROL_OP}|{dev}")
+    if group is None:
+        raise SystemExit(f"[control] training produced no serving.control|"
+                         f"{dev} group (need >= 6 regime keys, >= 3 "
+                         f"samples per arm)")
+
+    cfg, _, user_lens = ab_config(on_tpu, shared_prefix=True)
+    eng_base, n_req, base_rate, hand_mi = _control_geometry(on_tpu)
+    ps = eng_base["page_size"]
+    hand_un, hand_ov = _control_hand_knobs(hand_mi)
+    max_new = 12
+
+    def wl(rate):
+        return synth_shared_prefix_workload(
+            n_req, cfg.vocab_size, seed=seed, n_sys_prompts=8,
+            sys_len=6 * ps, user_lens=user_lens, max_new=max_new, rate=rate)
+
+    def run_cfg(knobs, workload, extras, plan):
+        kw = dict(eng_base)
+        kw.update(sv_control.engine_kwargs(knobs))
+        kw.update(extras)
+        return run_overload_arm(ServingEngine(cfg, seed=seed, **kw),
+                                workload, fault_plan=plan)
+
+    bench = {
+        "unloaded": dict(rate=base_rate, hand=hand_un, extras={}, plan=None),
+        "overload": dict(rate=10 * base_rate, hand=hand_ov, extras={},
+                         plan=None),
+        "overload_faulted": dict(
+            rate=10 * base_rate, hand=hand_ov,
+            extras=dict(audit_every=1, step_retries=2),
+            plan=OVERLOAD_FAULT_PLAN),
+    }
+    saved = {k: pt_flags.get_flag(k) for k in
+             ("serve_control_mode", "serve_control_model",
+              "serve_control_epoch_s")}
+    tmp_model = os.path.join(_tempfile.mkdtemp(prefix="serve_control_"),
+                             "control_model.json")
+    learned.save_model(model, tmp_model)
+    arms_out = {}
+    try:
+        pt_flags.set_flags({"serve_control_mode": "shadow"})
+        for name, a in bench.items():
+            w = wl(a["rate"])
+            hand_blk = run_cfg(a["hand"], w, a["extras"], a["plan"])
+            sig = _regime_sig(w, a["rate"], hand_blk)
+            proposal, info = sv_control.propose(sig, model=model)
+            if (sv_control.knob_key(proposal)
+                    == sv_control.knob_key(a["hand"])):
+                # identical config: re-measuring would only add noise
+                learned_blk = hand_blk
+            else:
+                learned_blk = run_cfg(proposal, w, a["extras"], a["plan"])
+            arm = {
+                "hand": hand_blk,
+                "learned": learned_blk,
+                "hand_knobs": sv_control.knob_key(a["hand"]),
+                "proposal": sv_control.knob_key(proposal),
+                "tier": info.get("tier"),
+                "sig": {k: round(float(v), 4) for k, v in sig.items()},
+                "regime": sv_control.regime_key(sig),
+                "ratio": round(learned_blk["goodput_tok_s"]
+                               / max(hand_blk["goodput_tok_s"], 1e-9), 3),
+            }
+            for k in ("reason", "rank_acc", "predicted_s_per_tok"):
+                if k in info:
+                    arm[k] = info[k]
+            arms_out[name] = arm
+            print(json.dumps({name: {"ratio": arm["ratio"],
+                                     "tier": arm["tier"],
+                                     "proposal": arm["proposal"]}}),
+                  flush=True)
+
+        # shadow-overhead A/B on the compute-bound overload trace, with a
+        # real model on the flag path and epochs short enough to fire
+        # inside a pass — shadow pays observe+propose, never an apply.
+        # 0.5 s epochs are a 10x stress over the 5 s default: a ceiling
+        # cleared here holds with an order of magnitude to spare
+        pt_flags.set_flags({"serve_control_model": tmp_model,
+                            "serve_control_epoch_s": 0.5})
+        sv_control.invalidate_model_cache()
+        kw = dict(eng_base)
+        kw.update(sv_control.engine_kwargs(hand_ov))
+        eng = ServingEngine(cfg, seed=seed, **kw)
+        w10 = wl(10 * base_rate)
+        run_overload_arm(eng, w10)  # warm compiles + caches
+        best = {"off": 0.0, "shadow": 0.0}
+        for _ in range(7):
+            for m in ("off", "shadow"):
+                pt_flags.set_flags({"serve_control_mode": m})
+                best[m] = max(best[m], _goodput_pass(eng, w10))
+        overhead = max(0.0, (1.0 - best["shadow"]
+                             / max(best["off"], 1e-9)) * 100.0)
+        shadow = {"shadow_overhead_pct": round(overhead, 2),
+                  "goodput_off": round(best["off"], 2),
+                  "goodput_shadow": round(best["shadow"], 2)}
+    finally:
+        pt_flags.set_flags(saved)
+        sv_control.invalidate_model_cache()
+
+    blocks = [a[s] for a in arms_out.values() for s in ("hand", "learned")]
+    return {
+        "campaign": "control",
+        "seed": seed,
+        "store": store_abs,
+        "store_rows": len(ctrl_recs),
+        "model": {"device": dev,
+                  "holdout": group["holdout"],
+                  "n_train_keys": group["n_train_keys"],
+                  "n_holdout_keys": len(group["holdout_keys"]),
+                  "arms": sorted(group["arms"])},
+        "arms": arms_out,
+        "learned_vs_hand": {n: a["ratio"] for n, a in arms_out.items()},
+        "shadow": shadow,
+        "leaked_pages": sum(b["kv_pages_leaked"] for b in blocks),
+        "refcount_leaks": sum(b["refcount_leaks"] for b in blocks),
+        "config": (f"shared-prefix sys{6 * ps} r{base_rate:g}->"
+                   f"r{10 * base_rate:g} n{n_req} mn{max_new} seed{seed}"),
+    }
+
+
 def ab_config(on_tpu: bool, shared_prefix: bool):
     """(cfg, prompt_lens, user_lens) for the sweep. The shared-prefix CPU
     config is deliberately LESS tiny than decoder_tiny: at decoder_tiny
@@ -845,6 +1168,23 @@ def main():
                          "(co-located / prefill-decode split / mid-handoff "
                          "kill) and print its JSON (redirect to "
                          "DISAGG_r*.json for gate.py --disagg)")
+    ap.add_argument("--sweep-knobs", action="store_true",
+                    help="run the ISSUE 20 knob sweep (12 traffic regimes "
+                         "x the control arm lattice) and append one "
+                         "measurement-store row per (regime, arm)")
+    ap.add_argument("--control", action="store_true",
+                    help="run the ISSUE 20 control A/B: train the "
+                         "serving.control group from the sweep store, "
+                         "replay the overload bench hand-vs-learned, "
+                         "measure shadow overhead (redirect to "
+                         "CONTROL_r*.json for gate.py --control)")
+    ap.add_argument("--control-store", default=None,
+                    help="measurement store for --sweep-knobs/--control "
+                         "(default: the tuning store / "
+                         "FLAGS_serve_control_store)")
+    ap.add_argument("--control-arms", type=int, default=6,
+                    help="sweep arm count for --sweep-knobs (default 6; "
+                         "the two hand references always measure)")
     args = ap.parse_args()
     if args.prefix_cache is not None:
         args.prefix_cache = bool(args.prefix_cache)
@@ -859,6 +1199,17 @@ def main():
         return
     if args.disagg:
         print(json.dumps(disagg_block(on_tpu, seed=args.seed)), flush=True)
+        return
+    if args.sweep_knobs:
+        print(json.dumps(sweep_knobs_block(on_tpu, seed=args.seed,
+                                           store=args.control_store,
+                                           n_arms=args.control_arms)),
+              flush=True)
+        return
+    if args.control:
+        print(json.dumps(control_block(on_tpu, seed=args.seed,
+                                       store=args.control_store)),
+              flush=True)
         return
 
     cfg, prompt_lens, user_lens = ab_config(on_tpu, args.shared_prefix)

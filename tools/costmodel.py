@@ -18,6 +18,9 @@ Subcommands:
               learned vs analytic arm-ranking accuracy per group (the
               gate.py --costmodel floor).
     report  — dataset inventory: records / keys / arms per group.
+    propose — confidence-gated serving-knob proposal for one traffic
+              regime (the serving controller's ridge tier, ISSUE 20 —
+              same `propose` call the live engine uses).
 
 Usage:
     python tools/costmodel.py collect --data COSTMODEL_DATA_cpu.jsonl
@@ -136,6 +139,47 @@ def cmd_eval(args) -> int:
     return 0
 
 
+def cmd_propose(args) -> int:
+    """Confidence-gated serving-knob proposal for one traffic regime —
+    the CLI face of the serving controller's ridge tier (ISSUE 20).
+    Operators, the control gate, and the live engine re-enter the policy
+    through the same `propose` call; the regime is given in the store's
+    own bucketed spelling (see serving/control/regime.py)."""
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu.serving import control as sv_control
+
+    try:
+        model = learned.load_model(args.model)
+    except ValueError as e:
+        print(json.dumps({"error": f"model {args.model!r}: {e}"}))
+        return 1
+    if model is None:
+        print(json.dumps({"error": f"model {args.model!r}: missing"}))
+        return 1
+    sig = sv_control.parse_regime(args.regime)
+    if sig is None:
+        print(json.dumps(
+            {"error": f"not a regime spelling: {args.regime!r} (fields: "
+                      f"{' '.join(sv_control.REGIME_FIELDS)}, e.g. "
+                      f"'rate=80 p50=32 p95=32 out=16 hit=95 occ=70 q=8 "
+                      f"hr=50')"}))
+        return 1
+    # the policy's off-mode short circuit is a runtime safety, not a CLI
+    # one: an explicit `propose` invocation always wants the model's view
+    old = pt_flags.get_flag("serve_control_mode")
+    pt_flags.set_flags({"serve_control_mode": "shadow"})
+    try:
+        proposal, info = sv_control.propose(sig, model=model,
+                                            dev=args.device or None)
+    finally:
+        pt_flags.set_flags({"serve_control_mode": old})
+    print(json.dumps({"regime": sv_control.regime_key(sig),
+                      "proposal": sv_control.knob_key(proposal),
+                      "knobs": proposal, "info": info}, sort_keys=True),
+          flush=True)
+    return 0
+
+
 def cmd_report(args) -> int:
     groups: dict = {}
     n = 0
@@ -188,6 +232,16 @@ def main(argv=None) -> int:
     pe.add_argument("--model", required=True)
     pe.add_argument("--data", required=True)
     pe.set_defaults(fn=cmd_eval)
+
+    pp = sub.add_parser("propose",
+                        help="serving-knob proposal for one traffic regime")
+    pp.add_argument("--model", required=True)
+    pp.add_argument("--regime", required=True,
+                    help="bucketed regime spelling, e.g. 'rate=80 p50=32 "
+                         "p95=32 out=16 hit=95 occ=70 q=8 hr=50'")
+    pp.add_argument("--device", default="",
+                    help="device kind group to consult (default: this host)")
+    pp.set_defaults(fn=cmd_propose)
 
     pr = sub.add_parser("report", help="dataset inventory")
     pr.add_argument("--data", required=True)

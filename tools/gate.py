@@ -187,6 +187,25 @@ FLEET_DETECT_BUDGET_BEATS = 4.0
 DISAGG_TTFT_CEIL_RATIO = 3.0
 DISAGG_QUEUE_BUDGET_WALL_FRAC = 0.5
 
+# learned serving control (ISSUE 20, `gate.py --control` over
+# CONTROL_r*.json from tools/_serve_ab.py --control). The learned proposal
+# must actually ENGAGE (tier "learned" on every bench arm — a model that
+# cannot clear its own confidence gate on its own training regimes proves
+# nothing), must meet-or-beat the hand config on the overloaded arms, and
+# may not regress the unloaded arm beyond the near-tie band (the same 5%
+# the A/B verdicts use). Shadow mode rides the serving hot path, so its
+# measured cost shares the telemetry layer's ~free ceiling. The control
+# group's holdout rank accuracy floor mirrors the kernel tier's: below it
+# the confidence gate would (rightly) refuse every proposal. When the
+# committed sweep dataset is present, the gate also retrains from it and
+# requires the artifact's proposals to reproduce exactly — the training
+# path is seeded-deterministic, so a mismatch means the artifact and
+# dataset drifted apart.
+CONTROL_WIN_FLOOR = 1.0
+CONTROL_TIE_BAND = 0.05
+CONTROL_RANK_ACC_FLOOR = 0.6
+CONTROL_DATA = "CONTROL_DATA_cpu.jsonl"
+
 
 def run_suite() -> int:
     print("[gate] running test suite ...", flush=True)
@@ -868,6 +887,135 @@ def check_disagg(path: str | None = None) -> int:
     return rc
 
 
+def check_control(path: str | None = None) -> int:
+    """`--control`: gate the newest (or given) CONTROL_r*.json artifact
+    (ISSUE 20, tools/_serve_ab.py --control). Hard zeros on leaks across
+    every measured engine; tier "learned" on every bench arm; overloaded
+    arms meet-or-beat the hand config; the unloaded arm inside the
+    near-tie band; shadow overhead under the telemetry ceiling; the
+    trained group's holdout rank accuracy above the confidence floor.
+    When CONTROL_DATA_cpu.jsonl is committed, retrain from it and require
+    the artifact's proposals to reproduce."""
+    arts = sorted(glob.glob(os.path.join(REPO, "CONTROL_r*.json")))
+    if path is None:
+        if not arts:
+            print("[gate] WARN: no CONTROL_r*.json artifact", flush=True)
+            return 0
+        path = arts[-1]
+    label = os.path.basename(path)
+    try:
+        with open(path) as f:
+            data = json.loads(f.read())
+    except (OSError, ValueError) as e:
+        print(f"[gate] WARN: cannot read control artifact {path}: {e}",
+              flush=True)
+        return 0
+    if not isinstance(data, dict) or "arms" not in data:
+        print(f"[gate] WARN: {label} carries no control arms — skipped",
+              flush=True)
+        return 0
+    rc = 0
+    if data.get("leaked_pages") or data.get("refcount_leaks"):
+        print(f"[gate] FAIL: control campaign leaked "
+              f"{data.get('leaked_pages')} page(s) / "
+              f"{data.get('refcount_leaks')} refcount(s) — an actuated "
+              f"engine must hold the same hard zeros as a hand one",
+              flush=True)
+        rc = 1
+    arms = data.get("arms") or {}
+    for arm, row in sorted(arms.items()):
+        ratio, tier = row.get("ratio"), row.get("tier")
+        print(f"[gate] control {label}: arm '{arm}' tier {tier}, learned "
+              f"{(row.get('learned') or {}).get('goodput_tok_s')} vs hand "
+              f"{(row.get('hand') or {}).get('goodput_tok_s')} goodput "
+              f"tok/s (x{ratio}), proposal [{row.get('proposal')}]",
+              flush=True)
+        if tier != "learned":
+            print(f"[gate] FAIL: arm '{arm}' fell back to the hand tier "
+                  f"({row.get('reason')}) — the model cannot clear its own "
+                  f"confidence gate on a regime it was trained on; the "
+                  f"sweep is too thin or the envelope too narrow",
+                  flush=True)
+            rc = 1
+        if ratio is None:
+            continue
+        floor = ((1.0 - CONTROL_TIE_BAND) if arm == "unloaded"
+                 else CONTROL_WIN_FLOOR)
+        if ratio < floor:
+            what = ("regressed the unloaded arm"
+                    if arm == "unloaded" else "lost to the hand config")
+            print(f"[gate] FAIL: the learned proposal {what} on '{arm}' "
+                  f"(x{ratio} < {floor:g}) — a controller that serves "
+                  f"fewer goodput tokens than the flags it replaces is a "
+                  f"regression", flush=True)
+            rc = 1
+    acc = ((data.get("model") or {}).get("holdout") or {}).get("rank_acc")
+    if acc is None or acc < CONTROL_RANK_ACC_FLOOR:
+        print(f"[gate] FAIL: serving.control holdout rank accuracy {acc} "
+              f"is under the {CONTROL_RANK_ACC_FLOOR:.0%} confidence floor "
+              f"— the committed model would refuse (or mis-rank) live "
+              f"proposals; widen the sweep", flush=True)
+        rc = 1
+    pct = (data.get("shadow") or {}).get("shadow_overhead_pct")
+    if pct is None or pct > OBS_OVERHEAD_CEIL_PCT:
+        print(f"[gate] FAIL: shadow-mode controller costs {pct}% of "
+              f"overload goodput (> {OBS_OVERHEAD_CEIL_PCT}%) — the "
+              f"observe/propose epoch landed on the serving hot path",
+              flush=True)
+        rc = 1
+    else:
+        print(f"[gate] control {label}: shadow overhead {pct}% "
+              f"(<= {OBS_OVERHEAD_CEIL_PCT}%), holdout rank-acc {acc}",
+              flush=True)
+    rc = _control_retrain_check(data, label) or rc
+    return rc
+
+
+def _control_retrain_check(data: dict, label: str) -> int:
+    """Determinism half of --control: retrain from the committed sweep
+    dataset and require every artifact proposal to reproduce. Training is
+    seeded (sorted keys, seeded permutation, closed-form ridge), so a
+    mismatch is drift between the committed dataset and artifact, not
+    noise."""
+    data_path = os.path.join(REPO, CONTROL_DATA)
+    if not os.path.exists(data_path):
+        print(f"[gate] WARN: {CONTROL_DATA} not committed — skipping the "
+              f"control retrain-determinism check", flush=True)
+        return 0
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from paddle_tpu import flags as pt_flags
+    from paddle_tpu.serving import control as sv_control
+    from paddle_tpu.tuning import learned
+
+    recs = list(learned.iter_records(data_path))
+    model = learned.train_model(recs, seed=int(data.get("seed", 0)))
+    rc = 0
+    old_mode = pt_flags.get_flag("serve_control_mode")
+    pt_flags.set_flags({"serve_control_mode": "shadow"})
+    try:
+        for arm, row in sorted((data.get("arms") or {}).items()):
+            sig = row.get("sig")
+            if not isinstance(sig, dict):
+                continue
+            proposal, info = sv_control.propose(sig, model=model)
+            got = sv_control.knob_key(proposal)
+            want = row.get("proposal")
+            if got != want:
+                print(f"[gate] FAIL: retraining from {CONTROL_DATA} "
+                      f"proposes [{got}] for arm '{arm}' but the artifact "
+                      f"recorded [{want}] — dataset and artifact drifted "
+                      f"apart; re-run tools/_serve_ab.py --control",
+                      flush=True)
+                rc = 1
+    finally:
+        pt_flags.set_flags({"serve_control_mode": old_mode})
+    if rc == 0:
+        print(f"[gate] control {label}: proposals reproduce from "
+              f"{CONTROL_DATA} ({len(recs)} rows)", flush=True)
+    return rc
+
+
 def _check_obs(data: dict, label: str, require: bool = False) -> int:
     """Telemetry-block gate (ISSUE 13). Three failure modes:
       * missing block (only when `require` — artifacts predating the layer
@@ -1111,6 +1259,9 @@ def main() -> int:
     if "--disagg" in sys.argv:
         arg = sys.argv[sys.argv.index("--disagg") + 1:]
         return check_disagg(arg[0] if arg else None)
+    if "--control" in sys.argv:
+        arg = sys.argv[sys.argv.index("--control") + 1:]
+        return check_control(arg[0] if arg else None)
     rc = run_suite()
     if "--fast" not in sys.argv:
         rc = rc or run_entry()
@@ -1120,6 +1271,7 @@ def main() -> int:
         rc = rc or check_costmodel()
         rc = rc or check_fleet()
         rc = rc or check_disagg()
+        rc = rc or check_control()
     if rc == 0:
         print("[gate] OK — green suite, safe to snapshot")
     return rc
